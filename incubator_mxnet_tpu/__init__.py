@@ -1,0 +1,30 @@
+"""incubator_mxnet_tpu — a TPU-native deep learning framework with the
+capability surface of Apache MXNet 0.12.1 (reference:
+solin319/incubator-mxnet), re-designed for JAX/XLA/Pallas/pjit.
+
+Usage mirrors the reference::
+
+    import incubator_mxnet_tpu as mx
+    x = mx.nd.ones((2, 3), ctx=mx.tpu(0))
+
+Layering (cf. SURVEY.md §1): context/engine facades over PJRT+XLA
+async dispatch -> NDArray -> central op registry (generates nd & sym
+surfaces) -> autograd tape / Symbol graph -> Executor (whole graph =
+one XLA executable) -> Module & Gluon trainers -> KVStore over
+ICI-mesh collectives.
+"""
+from .base import __version__, TShape, MXTPUError
+from . import utils
+from .context import (Context, cpu, tpu, gpu, cpu_pinned, num_tpus,
+                      num_gpus, current_context, default_context)
+from . import engine
+from . import ops
+from . import ndarray
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import random_state
+from . import random
+from . import autograd
+
+__all__ = ["nd", "ndarray", "autograd", "Context", "cpu", "tpu", "gpu",
+           "random", "NDArray", "TShape", "__version__"]

@@ -1,0 +1,302 @@
+"""Imperative autograd: record/pause scopes, tape, backward.
+
+API parity with the reference's mx.autograd (ref:
+python/mxnet/autograd.py — record:122, pause, train_mode/predict_mode,
+mark_variables, backward:243, grad:270, Function:364; C++ side
+src/imperative/imperative.cc Backward:361).
+
+Design: instead of the reference's nnvm-graph tape + imperative
+re-execution, every recorded op captures its jax VJP closure at call
+time (``jax.vjp`` during the forward).  backward() walks the tape in
+reverse topological order calling those closures — XLA has already
+compiled each, and fuses chains of them when backward runs under jit
+(executor path).
+"""
+import threading
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+           "is_training", "set_recording", "set_training",
+           "mark_variables", "backward", "grad", "get_symbol", "Function"]
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "recording"):
+        _state.recording = False
+        _state.training = False
+    return _state
+
+
+def is_recording():
+    return _st().recording
+
+
+def is_training():
+    return _st().training
+
+
+def set_recording(is_record):
+    prev = _st().recording
+    _st().recording = bool(is_record)
+    return prev
+
+
+def set_training(train_mode_):
+    prev = _st().training
+    _st().training = bool(train_mode_)
+    return prev
+
+
+class _RecordingStateScope:
+    def __init__(self, is_record, train):
+        self._rec = is_record
+        self._train = train
+
+    def __enter__(self):
+        s = _st()
+        self._prev = (s.recording, s.training)
+        if self._rec is not None:
+            s.recording = self._rec
+        if self._train is not None:
+            s.training = self._train
+        return self
+
+    def __exit__(self, *exc):
+        s = _st()
+        s.recording, s.training = self._prev
+
+
+def record(train_mode=True):
+    """Scope in which imperative ops are recorded for backward()."""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode=False):
+    """Scope in which recording (and by default training mode) is off."""
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    return _RecordingStateScope(None, False)
+
+
+# ---------------------------------------------------------------------------
+# tape
+# ---------------------------------------------------------------------------
+
+
+class TapeNode:
+    """One recorded op: the vjp closure plus input links."""
+
+    __slots__ = ("vjp_fn", "inputs", "out_avals", "name")
+
+    def __init__(self, vjp_fn, inputs, out_avals, name):
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs        # list of NDArray (tensor inputs)
+        self.out_avals = out_avals  # [(shape, dtype)] per output
+        self.name = name
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach gradient buffers to arrays, making them autograd leaves
+    (ref: autograd.py mark_variables / imperative.cc MarkVariables)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._grad = g
+        v._grad_req = req
+        v._autograd = None
+
+
+def _zero_cotangent(shape, dtype):
+    if not jnp.issubdtype(np.dtype(dtype), jnp.floating) and \
+       not jnp.issubdtype(np.dtype(dtype), jnp.complexfloating):
+        return np.zeros(shape, jax.dtypes.float0)
+    return jnp.zeros(shape, dtype)
+
+
+def _toposort(heads):
+    """Reverse-topological order of tape nodes reachable from heads."""
+    order, seen = [], set()
+    # iterative DFS with post-order collection
+    for h in heads:
+        entry = getattr(h, "_autograd", None)
+        if entry is None:
+            continue
+        stack = [(entry[0], False)]
+        while stack:
+            node, processed = stack.pop()
+            if processed:
+                order.append(node)
+                continue
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            stack.append((node, True))
+            for inp in node.inputs:
+                e = getattr(inp, "_autograd", None) if inp is not None \
+                    else None
+                if e is not None and id(e[0]) not in seen:
+                    stack.append((e[0], False))
+    return order[::-1]  # heads-first
+
+
+def _run_backward(heads, head_grads, variables=None, retain_graph=False):
+    from .ndarray.ndarray import NDArray
+    heads = [heads] if isinstance(heads, NDArray) else list(heads)
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif isinstance(head_grads, NDArray):
+        head_grads = [head_grads]
+
+    # cotangent accumulator keyed by (id(node), out_idx); plus leaf grads
+    cts = {}
+    leaf_grads = {}
+
+    def _add_ct(arr, ct):
+        entry = getattr(arr, "_autograd", None)
+        if entry is not None:
+            key = (id(entry[0]), entry[1])
+            cts[key] = ct if key not in cts else cts[key] + ct
+        if getattr(arr, "_grad", None) is not None or (
+                variables is not None and
+                any(arr is v for v in variables)):
+            k = id(arr)
+            if k in leaf_grads:
+                leaf_grads[k] = (arr, leaf_grads[k][1] + ct)
+            else:
+                leaf_grads[k] = (arr, ct)
+
+    for h, hg in zip(heads, head_grads):
+        ct = jnp.ones(h.shape, h._data.dtype) if hg is None else hg._data
+        _add_ct(h, ct)
+
+    for node in _toposort(heads):
+        outs_ct = []
+        missing = True
+        for i, (shape, dtype) in enumerate(node.out_avals):
+            key = (id(node), i)
+            if key in cts:
+                outs_ct.append(cts.pop(key))
+                missing = False
+            else:
+                outs_ct.append(_zero_cotangent(shape, dtype))
+        if missing:
+            continue
+        arg = tuple(outs_ct) if len(outs_ct) > 1 else outs_ct[0]
+        in_cts = node.vjp_fn(arg)
+        for inp, ct in zip(node.inputs, in_cts):
+            if inp is None or ct is None:
+                continue
+            if isinstance(ct, np.ndarray) and ct.dtype == jax.dtypes.float0:
+                continue
+            _add_ct(inp, ct)
+
+    # write leaf gradients honoring grad_req
+    for arr, ct in leaf_grads.values():
+        req = getattr(arr, "_grad_req", "write")
+        if req == "null":
+            continue
+        gbuf = getattr(arr, "_grad", None)
+        if gbuf is not None:
+            if req == "add":
+                gbuf._data = gbuf._data + ct
+            else:
+                gbuf._data = ct.astype(gbuf._data.dtype) \
+                    if ct.dtype != gbuf._data.dtype else ct
+    if not retain_graph:
+        for h in heads:
+            h._autograd = None
+    return leaf_grads
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Compute gradients of heads w.r.t. marked variables, storing them
+    in each variable's attached grad buffer."""
+    _run_backward(heads, head_grads, retain_graph=retain_graph)
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False):
+    """Return gradients of heads w.r.t. ``variables``
+    (ref: autograd.py grad:270).  create_graph (2nd order) is not yet
+    recorded; use jax.grad composition for higher-order needs."""
+    from .ndarray.ndarray import NDArray
+    variables = [variables] if isinstance(variables, NDArray) \
+        else list(variables)
+    leaf = _run_backward(heads, head_grads, variables=variables,
+                         retain_graph=bool(retain_graph or create_graph))
+    out = []
+    for v in variables:
+        if id(v) not in leaf:
+            raise ValueError("one of the variables does not participate "
+                             "in the graph of heads")
+        out.append(NDArray(leaf[id(v)][1], ctx=v.context))
+    return out
+
+
+def get_symbol(x):
+    """Trace the recorded history of ``x`` into a Symbol — the analog
+    of autograd.get_symbol.  Currently returns None placeholder."""
+    raise NotImplementedError(
+        "get_symbol: use sym/hybridize tracing instead")
+
+
+class Function:
+    """User-defined differentiable function
+    (ref: python/mxnet/autograd.py Function:364).
+
+    Subclass and implement forward(self, *inputs) and
+    backward(self, *output_grads), both on NDArrays.
+    """
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *arrays):
+        self._saved = arrays
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray
+        with pause():
+            outputs = self.forward(*inputs)
+        single = isinstance(outputs, NDArray)
+        outs = [outputs] if single else list(outputs)
+        if is_recording():
+            func = self
+
+            def vjp_fn(out_cts):
+                cts = (out_cts,) if len(outs) == 1 else out_cts
+                with pause():
+                    grads = func.backward(
+                        *[NDArray(c) for c in cts])
+                if isinstance(grads, NDArray):
+                    grads = [grads]
+                return [g._data if g is not None else None for g in grads]
+
+            node = TapeNode(vjp_fn, list(inputs),
+                            [(o.shape, o._data.dtype) for o in outs],
+                            type(self).__name__)
+            for i, o in enumerate(outs):
+                o._autograd = (node, i)
+        return outputs if single else outs

@@ -1,0 +1,61 @@
+"""Core scalar/shape/dtype helpers (role of include/mxnet/base.h +
+mshadow dtype enum in the reference).
+"""
+import numpy as np
+
+__version__ = "0.1.0"
+
+# mshadow dtype enum parity (ref: mshadow kFloat32... used across C API)
+_DTYPE_NP_TO_MX = {np.dtype(np.float32): 0, np.dtype(np.float64): 1,
+                   np.dtype(np.float16): 2, np.dtype(np.uint8): 3,
+                   np.dtype(np.int32): 4, np.dtype(np.int8): 5,
+                   np.dtype(np.int64): 6}
+_DTYPE_MX_TO_NP = {v: k for k, v in _DTYPE_NP_TO_MX.items()}
+
+_ALIASES = {"float": "float32", "double": "float64", "half": "float16",
+            "bf16": "bfloat16"}
+
+
+def np_dtype(dtype):
+    """Normalize a dtype-ish (str/np.dtype/type/int enum) to np.dtype.
+
+    Supports bfloat16 via ml_dtypes (what jax uses natively).
+    """
+    if isinstance(dtype, int):
+        return _DTYPE_MX_TO_NP[dtype]
+    if isinstance(dtype, str):
+        dtype = _ALIASES.get(dtype, dtype)
+        if dtype == "bfloat16":
+            import ml_dtypes
+            return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(dtype)
+
+
+def dtype_enum(dtype):
+    """np dtype -> reference integer enum (for serialization parity)."""
+    d = np_dtype(dtype)
+    if d not in _DTYPE_NP_TO_MX:
+        # bfloat16 and friends get codes above the reference range
+        return 100
+    return _DTYPE_NP_TO_MX[d]
+
+
+class TShape(tuple):
+    """Shape tuple (role of mshadow TShape / nnvm TShape)."""
+
+    def __new__(cls, dims=()):
+        return super().__new__(cls, (int(d) for d in dims))
+
+    @property
+    def ndim(self):
+        return len(self)
+
+    def prod(self):
+        out = 1
+        for d in self:
+            out *= d
+        return out
+
+
+class MXTPUError(RuntimeError):
+    """Framework error type (role of dmlc::Error / MXGetLastError)."""

@@ -1,0 +1,17 @@
+"""``nd`` namespace: NDArray plus the generated imperative op surface."""
+import sys as _sys
+
+from .ndarray import (NDArray, array, zeros, ones, full, empty, arange,
+                      concatenate, imperative_invoke, waitall, moveaxis,
+                      save, load)
+from . import register as _register
+
+_internal = _register.populate(_sys.modules[__name__])
+
+from . import random   # noqa: E402
+from . import linalg   # noqa: E402
+from .sparse import CSRNDArray, RowSparseNDArray  # noqa: E402
+
+__all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
+           "concatenate", "waitall", "moveaxis", "save", "load", "random",
+           "linalg", "CSRNDArray", "RowSparseNDArray"]

@@ -1,0 +1,657 @@
+"""NDArray: the imperative tensor, backed by a jax.Array.
+
+Role analog of the reference NDArray (ref: include/mxnet/ndarray.h:79,
+src/ndarray/ndarray.cc) and the op-invoke path (ref:
+src/imperative/imperative.cc Invoke:86, imperative_utils.h
+PushFCompute:328).
+
+TPU-native design notes:
+- The reference's async dependency engine is replaced by JAX async
+  dispatch: every op call returns immediately with a future-backed
+  jax.Array; ``wait_to_read`` / ``asnumpy`` are the sync points.
+- Mutation (`x[:] = v`, `+=`, optimizer updates) rebinds the
+  underlying buffer to a new functional value — identical observable
+  semantics, jit/XLA-safe, and donation-friendly.
+- Autograd recording captures a jax.vjp closure per op (autograd.py).
+"""
+import numbers
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import autograd, engine, random_state
+from ..base import np_dtype, TShape
+from ..context import Context, default_context
+from ..ops.registry import get_op
+
+__all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
+           "concatenate", "imperative_invoke", "waitall", "moveaxis",
+           "save", "load"]
+
+
+def _device_of(ctx):
+    return ctx.jax_device if isinstance(ctx, Context) else None
+
+
+class NDArray:
+    """Multi-dimensional array with async execution semantics."""
+
+    # grad/autograd attrs are set lazily:
+    #   _grad (NDArray|None), _grad_req (str), _autograd ((TapeNode,int)|None)
+
+    def __init__(self, data, ctx=None):
+        if isinstance(data, NDArray):
+            data = data._data
+        self._data = data
+        self._ctx = ctx
+
+    # ------------------------------------------------------------ properties
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._data.dtype)
+
+    @property
+    def size(self):
+        return int(self._data.size)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def context(self):
+        if self._ctx is not None:
+            return self._ctx
+        try:
+            dev = list(self._data.devices())[0]
+        except Exception:
+            return default_context()
+        if dev.platform == "cpu":
+            return Context("cpu", dev.id)
+        return Context("tpu", dev.id)
+
+    ctx = context
+
+    @property
+    def stype(self):
+        return "default"
+
+    @property
+    def grad(self):
+        return getattr(self, "_grad", None)
+
+    @property
+    def T(self):
+        return NDArray(self._data.T, self._ctx)
+
+    @property
+    def handle(self):
+        """Opaque handle (API parity; the jax.Array itself)."""
+        return self._data
+
+    # ------------------------------------------------------------ sync
+    def wait_to_read(self):
+        """Block until this array's value is computed
+        (analog of Engine::WaitForVar)."""
+        jax.block_until_ready(self._data)
+        return self
+
+    def asnumpy(self):
+        """Copy to a numpy array (synchronizes)."""
+        return np.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("The current array is not a scalar")
+        return self.asnumpy().reshape(())[()]
+
+    def item(self):
+        return self.asscalar()
+
+    # ------------------------------------------------------------ conversion
+    def astype(self, dtype, copy=True):
+        return NDArray(self._data.astype(np_dtype(dtype)), self._ctx)
+
+    def copy(self):
+        return NDArray(self._data + 0, self._ctx)
+
+    def copyto(self, other):
+        """Copy into another NDArray or to a Context
+        (ref: ndarray.cc CopyFromTo:514)."""
+        if isinstance(other, Context):
+            return NDArray(jax.device_put(self._data, other.jax_device),
+                           other)
+        other._data = jax.device_put(
+            self._data.astype(other._data.dtype),
+            list(other._data.devices())[0])
+        return other
+
+    def as_in_context(self, ctx):
+        if ctx == self.context:
+            return self
+        return NDArray(jax.device_put(self._data, ctx.jax_device), ctx)
+
+    def detach(self):
+        out = NDArray(self._data, self._ctx)
+        return out
+
+    def tostype(self, stype):
+        if stype == "default":
+            return self
+        from .sparse import cast_storage
+        return cast_storage(self, stype)
+
+    def attach_grad(self, grad_req="write", stype=None):
+        """Allocate a gradient buffer for autograd
+        (ref: ndarray.py attach_grad)."""
+        grad = NDArray(jnp.zeros_like(self._data), self._ctx)
+        autograd.mark_variables([self], [grad], grad_req)
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        autograd.backward([self], [out_grad] if out_grad is not None
+                          else None, retain_graph, train_mode)
+
+    # ------------------------------------------------------------ shape ops
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        if kwargs.get("shape"):
+            shape = tuple(kwargs["shape"])
+        return imperative_invoke(get_op("Reshape"), (self,),
+                                 {"shape": shape,
+                                  "reverse": kwargs.get("reverse", False)})
+
+    def reshape_like(self, other):
+        return NDArray(self._data.reshape(other.shape), self._ctx)
+
+    def broadcast_to(self, shape):
+        return imperative_invoke(get_op("broadcast_to"), (self,),
+                                 {"shape": tuple(shape)})
+
+    def broadcast_like(self, other):
+        return self.broadcast_to(other.shape)
+
+    def expand_dims(self, axis):
+        return imperative_invoke(get_op("expand_dims"), (self,),
+                                 {"axis": axis})
+
+    def flatten(self):
+        return imperative_invoke(get_op("Flatten"), (self,), {})
+
+    def transpose(self, axes=()):
+        return imperative_invoke(get_op("transpose"), (self,),
+                                 {"axes": axes})
+
+    def swapaxes(self, dim1, dim2):
+        return imperative_invoke(get_op("SwapAxis"), (self,),
+                                 {"dim1": dim1, "dim2": dim2})
+
+    def flip(self, axis):
+        return imperative_invoke(get_op("reverse"), (self,), {"axis": axis})
+
+    def split(self, num_outputs, axis=1, squeeze_axis=False):
+        return imperative_invoke(
+            get_op("SliceChannel"), (self,),
+            {"num_outputs": num_outputs, "axis": axis,
+             "squeeze_axis": squeeze_axis})
+
+    def slice(self, begin, end, step=()):
+        return imperative_invoke(get_op("slice"), (self,),
+                                 {"begin": begin, "end": end, "step": step})
+
+    def slice_axis(self, axis, begin, end):
+        return imperative_invoke(get_op("slice_axis"), (self,),
+                                 {"axis": axis, "begin": begin, "end": end})
+
+    def take(self, indices, axis=0, mode="clip"):
+        return imperative_invoke(get_op("take"), (self, indices),
+                                 {"axis": axis, "mode": mode})
+
+    def pick(self, index, axis=-1, keepdims=False):
+        return imperative_invoke(get_op("pick"), (self, index),
+                                 {"axis": axis, "keepdims": keepdims})
+
+    def one_hot(self, depth, **kw):
+        return imperative_invoke(get_op("one_hot"), (self,),
+                                 dict(depth=depth, **kw))
+
+    def clip(self, a_min, a_max):
+        return imperative_invoke(get_op("clip"), (self,),
+                                 {"a_min": a_min, "a_max": a_max})
+
+    def repeat(self, repeats, axis=None):
+        return imperative_invoke(get_op("repeat"), (self,),
+                                 {"repeats": repeats, "axis": axis})
+
+    def tile(self, reps):
+        return imperative_invoke(get_op("tile"), (self,), {"reps": reps})
+
+    def pad(self, mode="constant", pad_width=(), constant_value=0.0):
+        return imperative_invoke(get_op("Pad"), (self,),
+                                 {"mode": mode, "pad_width": pad_width,
+                                  "constant_value": constant_value})
+
+    # ------------------------------------------------------------ reductions
+    def _reduce(self, opname, axis=None, keepdims=False, **kw):
+        return imperative_invoke(get_op(opname), (self,),
+                                 dict(axis=axis, keepdims=keepdims, **kw))
+
+    def sum(self, axis=None, keepdims=False):
+        return self._reduce("sum", axis, keepdims)
+
+    def mean(self, axis=None, keepdims=False):
+        return self._reduce("mean", axis, keepdims)
+
+    def prod(self, axis=None, keepdims=False):
+        return self._reduce("prod", axis, keepdims)
+
+    def max(self, axis=None, keepdims=False):
+        return self._reduce("max", axis, keepdims)
+
+    def min(self, axis=None, keepdims=False):
+        return self._reduce("min", axis, keepdims)
+
+    def norm(self, ord=2, axis=None, keepdims=False):
+        return imperative_invoke(get_op("norm"), (self,),
+                                 {"ord": ord, "axis": axis,
+                                  "keepdims": keepdims})
+
+    def argmax(self, axis=None, keepdims=False):
+        return imperative_invoke(get_op("argmax"), (self,),
+                                 {"axis": axis, "keepdims": keepdims})
+
+    def argmin(self, axis=None, keepdims=False):
+        return imperative_invoke(get_op("argmin"), (self,),
+                                 {"axis": axis, "keepdims": keepdims})
+
+    def argsort(self, axis=-1, is_ascend=True):
+        return imperative_invoke(get_op("argsort"), (self,),
+                                 {"axis": axis, "is_ascend": is_ascend})
+
+    def sort(self, axis=-1, is_ascend=True):
+        return imperative_invoke(get_op("sort"), (self,),
+                                 {"axis": axis, "is_ascend": is_ascend})
+
+    def topk(self, axis=-1, k=1, ret_typ="indices", is_ascend=False):
+        return imperative_invoke(get_op("topk"), (self,),
+                                 {"axis": axis, "k": k, "ret_typ": ret_typ,
+                                  "is_ascend": is_ascend})
+
+    def dot(self, other, **kw):
+        return imperative_invoke(get_op("dot"), (self, other), kw)
+
+    # elementwise convenience mirrors
+    def abs(self):
+        return imperative_invoke(get_op("abs"), (self,), {})
+
+    def sqrt(self):
+        return imperative_invoke(get_op("sqrt"), (self,), {})
+
+    def square(self):
+        return imperative_invoke(get_op("square"), (self,), {})
+
+    def exp(self):
+        return imperative_invoke(get_op("exp"), (self,), {})
+
+    def log(self):
+        return imperative_invoke(get_op("log"), (self,), {})
+
+    def sigmoid(self):
+        return imperative_invoke(get_op("sigmoid"), (self,), {})
+
+    def tanh(self):
+        return imperative_invoke(get_op("tanh"), (self,), {})
+
+    def relu(self):
+        return imperative_invoke(get_op("relu"), (self,), {})
+
+    def softmax(self, axis=-1):
+        return imperative_invoke(get_op("softmax"), (self,), {"axis": axis})
+
+    def log_softmax(self, axis=-1):
+        return imperative_invoke(get_op("log_softmax"), (self,),
+                                 {"axis": axis})
+
+    # ------------------------------------------------------------ arithmetic
+    def _binary(self, opname, scalar_opname, other, reverse=False):
+        if isinstance(other, NDArray):
+            a, b = (other, self) if reverse else (self, other)
+            return imperative_invoke(get_op(opname), (a, b), {})
+        if isinstance(other, numbers.Number):
+            name = scalar_opname
+            return imperative_invoke(get_op(name), (self,),
+                                     {"scalar": other})
+        return NotImplemented
+
+    def __add__(self, o):
+        return self._binary("broadcast_add", "_plus_scalar", o)
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binary("broadcast_sub", "_minus_scalar", o)
+
+    def __rsub__(self, o):
+        if isinstance(o, numbers.Number):
+            return imperative_invoke(get_op("_rminus_scalar"), (self,),
+                                     {"scalar": o})
+        return self._binary("broadcast_sub", "_minus_scalar", o, True)
+
+    def __mul__(self, o):
+        return self._binary("broadcast_mul", "_mul_scalar", o)
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binary("broadcast_div", "_div_scalar", o)
+
+    def __rtruediv__(self, o):
+        if isinstance(o, numbers.Number):
+            return imperative_invoke(get_op("_rdiv_scalar"), (self,),
+                                     {"scalar": o})
+        return self._binary("broadcast_div", "_div_scalar", o, True)
+
+    __div__ = __truediv__
+    __rdiv__ = __rtruediv__
+
+    def __mod__(self, o):
+        return self._binary("broadcast_mod", "_mod_scalar", o)
+
+    def __rmod__(self, o):
+        if isinstance(o, numbers.Number):
+            return imperative_invoke(get_op("_rmod_scalar"), (self,),
+                                     {"scalar": o})
+        return self._binary("broadcast_mod", "_mod_scalar", o, True)
+
+    def __pow__(self, o):
+        return self._binary("broadcast_power", "_power_scalar", o)
+
+    def __rpow__(self, o):
+        if isinstance(o, numbers.Number):
+            return imperative_invoke(get_op("_rpower_scalar"), (self,),
+                                     {"scalar": o})
+        return NotImplemented
+
+    def __neg__(self):
+        return imperative_invoke(get_op("negative"), (self,), {})
+
+    def __abs__(self):
+        return self.abs()
+
+    def __eq__(self, o):
+        if o is None:
+            return False
+        return self._binary("broadcast_equal", "_equal_scalar", o)
+
+    def __ne__(self, o):
+        if o is None:
+            return True
+        return self._binary("broadcast_not_equal", "_not_equal_scalar", o)
+
+    def __gt__(self, o):
+        return self._binary("broadcast_greater", "_greater_scalar", o)
+
+    def __ge__(self, o):
+        return self._binary("broadcast_greater_equal",
+                            "_greater_equal_scalar", o)
+
+    def __lt__(self, o):
+        return self._binary("broadcast_lesser", "_lesser_scalar", o)
+
+    def __le__(self, o):
+        return self._binary("broadcast_lesser_equal",
+                            "_lesser_equal_scalar", o)
+
+    __hash__ = object.__hash__
+
+    # in-place: rebind buffer (engine-ordered write analog)
+    def __iadd__(self, o):
+        out = self.__add__(o)
+        self._data = out._data
+        self._autograd = getattr(out, "_autograd", None)
+        return self
+
+    def __isub__(self, o):
+        out = self.__sub__(o)
+        self._data = out._data
+        self._autograd = getattr(out, "_autograd", None)
+        return self
+
+    def __imul__(self, o):
+        out = self.__mul__(o)
+        self._data = out._data
+        self._autograd = getattr(out, "_autograd", None)
+        return self
+
+    def __itruediv__(self, o):
+        out = self.__truediv__(o)
+        self._data = out._data
+        self._autograd = getattr(out, "_autograd", None)
+        return self
+
+    # ------------------------------------------------------------ indexing
+    def __len__(self):
+        return self.shape[0] if self.shape else 0
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError("ambiguous truth value of multi-element array")
+        return bool(self.asscalar())
+
+    def _key(self, key):
+        if isinstance(key, NDArray):
+            return key._data
+        if isinstance(key, tuple):
+            return tuple(k._data if isinstance(k, NDArray) else k
+                         for k in key)
+        return key
+
+    def __getitem__(self, key):
+        out = self._data[self._key(key)]
+        return NDArray(out, self._ctx)
+
+    def __setitem__(self, key, value):
+        if isinstance(value, NDArray):
+            value = value._data
+        if isinstance(key, slice) and key == slice(None):
+            if isinstance(value, numbers.Number):
+                self._data = jnp.full_like(self._data, value)
+            else:
+                self._data = jnp.broadcast_to(
+                    jnp.asarray(value, self._data.dtype),
+                    self.shape) + jnp.zeros_like(self._data)
+            return
+        self._data = self._data.at[self._key(key)].set(value)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __repr__(self):
+        return (f"\n{self.asnumpy()}\n<NDArray {self.shape} "
+                f"@{self.context}>")
+
+    # numpy protocol
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype else a
+
+
+# ---------------------------------------------------------------------------
+# the imperative invoke path (role of Imperative::Invoke)
+# ---------------------------------------------------------------------------
+
+
+def imperative_invoke(op, args, kwargs, out=None):
+    """Execute a registered op on NDArrays; records for autograd."""
+    params = {k: v for k, v in kwargs.items()
+              if v is not None and k not in ("name", "ctx")}
+    ctx = kwargs.get("ctx")
+    jargs = []
+    nd_inputs = []
+    for a in args:
+        if isinstance(a, NDArray):
+            jargs.append(a._data)
+            nd_inputs.append(a)
+        elif a is None:
+            jargs.append(None)
+            nd_inputs.append(None)
+        else:
+            jargs.append(jnp.asarray(a))
+            nd_inputs.append(None)
+
+    if op.needs_mode:
+        params["_training"] = autograd.is_training()
+    if op.needs_rng:
+        params["_rng"] = random_state.next_key()
+
+    def fn(*xs):
+        return op.fn(*xs, **params)
+
+    recording = (autograd.is_recording() and op.differentiable
+                 and any(n is not None for n in nd_inputs))
+    if recording:
+        outs, vjp_fn = jax.vjp(fn, *jargs)
+    else:
+        outs = fn(*jargs)
+
+    single = not isinstance(outs, (tuple, list))
+    all_outs = [outs] if single else list(outs)
+    outs_list = all_outs
+
+    # aux-state writeback (BatchNorm moving stats): trailing outputs map
+    # onto the trailing `num_aux` inputs
+    n_aux_out = 0
+    if op.num_aux and params.get("_training"):
+        n_aux_out = op.num_aux
+        aux_new = outs_list[-n_aux_out:]
+        outs_list = outs_list[:-n_aux_out]
+        for nd_in, new in zip(nd_inputs[-op.num_aux:], aux_new):
+            if nd_in is not None:
+                nd_in._data = new
+
+    if ctx is not None and isinstance(ctx, Context):
+        outs_list = [jax.device_put(o, ctx.jax_device) for o in outs_list]
+
+    engine.maybe_block(outs_list)
+    out_ctx = ctx if isinstance(ctx, Context) else (
+        nd_inputs[0]._ctx if nd_inputs and nd_inputs[0] is not None
+        else None)
+    out_arrays = [NDArray(o, out_ctx) for o in outs_list]
+
+    if recording:
+        from .autograd_shim import make_node
+        # pass ALL fn outputs (incl. trailing aux) so the vjp closure's
+        # cotangent structure matches; aux slots get zero cotangents
+        make_node(op, vjp_fn, nd_inputs, all_outs, out_arrays, n_aux_out)
+
+    if out is not None:
+        targets = out if isinstance(out, (tuple, list)) else [out]
+        for t, o in zip(targets, out_arrays):
+            t._data = o._data
+            t._autograd = getattr(o, "_autograd", None)
+        return out
+    if len(out_arrays) == 1:
+        return out_arrays[0]
+    return out_arrays
+
+
+# ---------------------------------------------------------------------------
+# creation functions
+# ---------------------------------------------------------------------------
+
+
+def _put(data, ctx):
+    if ctx is not None:
+        data = jax.device_put(data, ctx.jax_device)
+    return NDArray(data, ctx)
+
+
+def array(source, ctx=None, dtype=None):
+    """Create an NDArray from array-like data."""
+    if isinstance(source, NDArray):
+        source = source.asnumpy()
+    a = np.asarray(source)
+    if dtype is None:
+        dtype = a.dtype
+    dtype = np_dtype(dtype)
+    # jax default config is 32-bit; avoid noisy truncation warnings
+    if not jax.config.jax_enable_x64:
+        dtype = {np.dtype(np.float64): np.dtype(np.float32),
+                 np.dtype(np.int64): np.dtype(np.int32),
+                 np.dtype(np.uint64): np.dtype(np.uint32)}.get(dtype, dtype)
+    return _put(jnp.asarray(a, dtype), ctx)
+
+
+def zeros(shape, ctx=None, dtype="float32", stype=None):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return _put(jnp.zeros(shape, np_dtype(dtype)), ctx)
+
+
+def ones(shape, ctx=None, dtype="float32"):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return _put(jnp.ones(shape, np_dtype(dtype)), ctx)
+
+
+def full(shape, val, ctx=None, dtype="float32"):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return _put(jnp.full(shape, val, np_dtype(dtype)), ctx)
+
+
+def empty(shape, ctx=None, dtype="float32"):
+    return zeros(shape, ctx, dtype)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None,
+           dtype="float32"):
+    out = jnp.arange(start, stop, step, np_dtype(dtype))
+    if repeat != 1:
+        out = jnp.repeat(out, int(repeat))
+    return _put(out, ctx)
+
+
+def moveaxis(tensor, source, destination):
+    return NDArray(jnp.moveaxis(tensor._data, source, destination),
+                   tensor._ctx)
+
+
+def concatenate(arrays, axis=0, always_copy=True):
+    return NDArray(jnp.concatenate([a._data for a in arrays], axis=axis),
+                   arrays[0]._ctx)
+
+
+def waitall():
+    engine.wait_all()
+
+
+# ---------------------------------------------------------------------------
+# serialization (ref: MXNDArraySave/Load, src/ndarray/ndarray.cc save/load)
+# ---------------------------------------------------------------------------
+
+
+def save(fname, data):
+    """Save NDArrays: list -> positional, dict -> named (npz-backed;
+    the exact filename is used, no extension is appended)."""
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, dict):
+        payload = {k: v.asnumpy() for k, v in data.items()}
+    else:
+        payload = {f"__pos_{i}": v.asnumpy() for i, v in enumerate(data)}
+    with open(fname, "wb") as f:
+        np.savez(f, **payload)
+
+
+def load(fname):
+    with np.load(fname, allow_pickle=False) as z:
+        keys = list(z.keys())
+        if keys and all(k.startswith("__pos_") for k in keys):
+            return [array(z[f"__pos_{i}"]) for i in range(len(keys))]
+        return {k: array(z[k]) for k in keys}

@@ -1,0 +1,43 @@
+"""Generate the imperative op surface from the central registry.
+
+Role analog of the reference's import-time codegen (ref:
+python/mxnet/ndarray/register.py:29-158, which builds Python functions
+from the C op registry).  Every registered OpDef becomes a callable on
+the ``nd`` namespace; names starting with '_' land on ``nd._internal``
+exactly like the reference.
+"""
+import types
+
+from ..ops.registry import OPS
+
+
+def make_nd_func(opname, op):
+    from .ndarray import imperative_invoke
+
+    def f(*args, out=None, name=None, **kwargs):
+        pos = list(args)
+        # accept tensor inputs by keyword (data=..., lhs=..., ...)
+        for an in op.arg_names[len(pos):]:
+            if an in kwargs:
+                pos.append(kwargs.pop(an))
+            else:
+                break
+        return imperative_invoke(op, pos, kwargs, out)
+
+    f.__name__ = opname
+    f.__qualname__ = opname
+    f.__doc__ = (op.doc or "") + "\n\n(auto-generated from the op registry)"
+    return f
+
+
+def populate(nd_module):
+    """Attach generated functions to the nd namespace module."""
+    internal = types.ModuleType(nd_module.__name__ + "._internal")
+    internal.__doc__ = "Internal (underscore) operators."
+    for name, op in OPS.items():
+        fn = make_nd_func(name, op)
+        setattr(internal, name, fn)
+        if not name.startswith("_") and not hasattr(nd_module, name):
+            setattr(nd_module, name, fn)
+    nd_module._internal = internal
+    return internal

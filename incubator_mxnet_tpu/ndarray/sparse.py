@@ -1,0 +1,162 @@
+"""Sparse NDArray storage types (ref: include/mxnet/ndarray.h:59-64
+storage-type enum; python/mxnet/ndarray/sparse.py).
+
+TPU-native design: XLA has no native sparse tensors, so CSR and
+row-sparse arrays are *structured dense* — index + value buffers with
+fixed capacity, the design SURVEY.md §7 stage 12 calls for.  Kernels
+(dot, elemwise) consume the structure directly with gather/scatter;
+``cast_storage`` converts to/from dense.
+
+Round-1 scope: construction, dense conversion, data access; sparse
+kernels arrive with the sparse milestone.
+"""
+import numpy as np
+
+import jax.numpy as jnp
+
+from .ndarray import NDArray, array as _dense_array
+
+__all__ = ["CSRNDArray", "RowSparseNDArray", "csr_matrix",
+           "row_sparse_array", "cast_storage", "zeros"]
+
+
+class BaseSparseNDArray(NDArray):
+    pass
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row matrix: data/indices/indptr buffers."""
+
+    def __init__(self, data, indices, indptr, shape):
+        self._sp_data = data            # NDArray (nnz,)
+        self._sp_indices = indices      # NDArray (nnz,) int
+        self._sp_indptr = indptr        # NDArray (rows+1,) int
+        self._sp_shape = tuple(shape)
+        super().__init__(self._todense_impl())
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def data(self):
+        return self._sp_data
+
+    @property
+    def indices(self):
+        return self._sp_indices
+
+    @property
+    def indptr(self):
+        return self._sp_indptr
+
+    def _todense_impl(self):
+        rows, cols = self._sp_shape
+        indptr = np.asarray(self._sp_indptr._data)
+        indices = np.asarray(self._sp_indices._data)
+        vals = np.asarray(self._sp_data._data)
+        out = np.zeros(self._sp_shape, vals.dtype)
+        for r in range(rows):
+            for p in range(indptr[r], indptr[r + 1]):
+                out[r, indices[p]] = vals[p]
+        return jnp.asarray(out)
+
+    def tostype(self, stype):
+        if stype == "csr":
+            return self
+        if stype == "default":
+            return NDArray(self._data)
+        raise ValueError(f"cast csr->{stype} unsupported")
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Row-sparse tensor: a subset of rows is materialized."""
+
+    def __init__(self, data, indices, shape):
+        self._sp_data = data        # NDArray (k, *shape[1:])
+        self._sp_indices = indices  # NDArray (k,) int row ids
+        self._sp_shape = tuple(shape)
+        dense = jnp.zeros(self._sp_shape, data._data.dtype).at[
+            indices._data.astype(jnp.int32)].set(data._data)
+        super().__init__(dense)
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def data(self):
+        return self._sp_data
+
+    @property
+    def indices(self):
+        return self._sp_indices
+
+    def tostype(self, stype):
+        if stype == "row_sparse":
+            return self
+        if stype == "default":
+            return NDArray(self._data)
+        raise ValueError(f"cast row_sparse->{stype} unsupported")
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """Create a CSRNDArray from (data, indices, indptr) or dense/scipy."""
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        return CSRNDArray(_dense_array(data, dtype=dtype),
+                          _dense_array(indices, dtype="int64"),
+                          _dense_array(indptr, dtype="int64"), shape)
+    dense = np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray)
+                       else arg1)
+    return _dense_to_csr(dense, shape or dense.shape)
+
+
+def _dense_to_csr(dense, shape):
+    indptr = [0]
+    indices, vals = [], []
+    for r in range(dense.shape[0]):
+        nz = np.nonzero(dense[r])[0]
+        indices.extend(nz.tolist())
+        vals.extend(dense[r][nz].tolist())
+        indptr.append(len(indices))
+    return CSRNDArray(_dense_array(np.asarray(vals, dense.dtype)),
+                      _dense_array(np.asarray(indices), dtype="int64"),
+                      _dense_array(np.asarray(indptr), dtype="int64"),
+                      shape)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        return RowSparseNDArray(_dense_array(data, dtype=dtype),
+                                _dense_array(indices, dtype="int64"),
+                                shape)
+    dense = np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray)
+                       else arg1)
+    rows = np.nonzero(np.any(dense.reshape(dense.shape[0], -1) != 0,
+                             axis=1))[0]
+    return RowSparseNDArray(_dense_array(dense[rows]),
+                            _dense_array(rows, dtype="int64"),
+                            shape or dense.shape)
+
+
+def cast_storage(arr, stype):
+    """(ref: src/operator/tensor/cast_storage.cc)"""
+    if stype == "default":
+        return NDArray(arr._data)
+    dense = np.asarray(arr._data)
+    if stype == "csr":
+        return _dense_to_csr(dense, dense.shape)
+    if stype == "row_sparse":
+        return row_sparse_array(dense)
+    raise ValueError(stype)
+
+
+def zeros(stype, shape, ctx=None, dtype="float32"):
+    if stype == "row_sparse":
+        return row_sparse_array(np.zeros(shape, dtype))
+    if stype == "csr":
+        return _dense_to_csr(np.zeros(shape, dtype), shape)
+    from .ndarray import zeros as dzeros
+    return dzeros(shape, ctx, dtype)

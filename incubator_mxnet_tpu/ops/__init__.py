@@ -1,0 +1,21 @@
+"""Operator library: importing this package registers every op.
+
+Single source of truth for the op surface (see registry.py); the
+``nd`` and ``sym`` namespaces are generated from it.
+"""
+from .registry import OPS, OpDef, defop, alias, get_op, find_op, list_ops
+
+# registration side-effects — order matters only for alias targets
+from . import elemwise      # noqa: F401
+from . import reduce        # noqa: F401
+from . import matrix        # noqa: F401
+from . import indexing      # noqa: F401
+from . import init_op       # noqa: F401
+from . import order         # noqa: F401
+from . import nn            # noqa: F401
+from . import la            # noqa: F401
+from . import optimizer_op  # noqa: F401
+from . import random_op     # noqa: F401
+
+__all__ = ["OPS", "OpDef", "defop", "alias", "get_op", "find_op",
+           "list_ops"]

@@ -1,0 +1,241 @@
+"""Elementwise, scalar and comparison operators.
+
+Covers the reference's macro-registered elemwise surface (ref:
+src/operator/tensor/elemwise_unary_op_basic.cc, elemwise_unary_op_trig.cc,
+elemwise_binary_op_basic.cc, elemwise_binary_scalar_op_*.cc).  On TPU
+every one of these is a VPU op that XLA fuses into neighbouring
+matmuls, so there is no per-op kernel: each is a one-line jnp emission.
+"""
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from .registry import defop, alias
+
+# --------------------------------------------------------------------------
+# unary math (ref: MXNET_UNARY_MATH_OP sites)
+# --------------------------------------------------------------------------
+_UNARY = {
+    "abs": jnp.abs,
+    "arccos": jnp.arccos,
+    "arccosh": jnp.arccosh,
+    "arcsin": jnp.arcsin,
+    "arcsinh": jnp.arcsinh,
+    "arctan": jnp.arctan,
+    "arctanh": jnp.arctanh,
+    "cbrt": jnp.cbrt,
+    "ceil": jnp.ceil,
+    "cos": jnp.cos,
+    "cosh": jnp.cosh,
+    "degrees": jnp.degrees,
+    "exp": jnp.exp,
+    "expm1": jnp.expm1,
+    "fix": jnp.trunc,
+    "floor": jnp.floor,
+    "gammaln": jsp.gammaln,
+    "log": jnp.log,
+    "log10": jnp.log10,
+    "log1p": jnp.log1p,
+    "log2": jnp.log2,
+    "negative": jnp.negative,
+    "radians": jnp.radians,
+    "rint": jnp.rint,
+    "round": jnp.round,
+    "sign": jnp.sign,
+    "sin": jnp.sin,
+    "sinh": jnp.sinh,
+    "sqrt": jnp.sqrt,
+    "square": jnp.square,
+    "tan": jnp.tan,
+    "tanh": jnp.tanh,
+    "trunc": jnp.trunc,
+    "reciprocal": lambda x: 1.0 / x,
+    "rsqrt": lambda x: jax.lax.rsqrt(x),
+    "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+    "relu": lambda x: jnp.maximum(x, 0),
+    "sigmoid": jax.nn.sigmoid,
+    "softsign": lambda x: x / (1.0 + jnp.abs(x)),
+    "erf": jsp.erf,
+    "erfinv": jsp.erfinv,
+}
+
+
+def _make_unary(name, f):
+    def _op(data, _f=f):
+        return _f(data)
+    _op.__name__ = name
+    _op.__doc__ = f"Elementwise {name} (ref: src/operator/tensor/)."
+    return _op
+
+
+for _n, _f in _UNARY.items():
+    defop(_n)(_make_unary(_n, _f))
+
+
+@defop("gamma")
+def gamma(data):
+    """Gamma function Γ(x) (ref: special_functions-inl.h).
+
+    gammaln gives log|Γ|; restore the sign for negative non-integer x,
+    where Γ alternates sign between consecutive poles.
+    """
+    sign = jnp.where(data >= 0, 1.0,
+                     1.0 - 2.0 * (jnp.abs(jnp.floor(data)) % 2))
+    return sign.astype(data.dtype) * jnp.exp(jsp.gammaln(data))
+
+
+@defop("_copy", aliases=["identity"])
+def _copy(data):
+    """Identity / copy."""
+    return data + 0
+
+
+@defop("BlockGrad", aliases=["stop_gradient"])
+def block_grad(data):
+    """Identity forward, zero gradient (ref: make_loss BlockGrad)."""
+    return jax.lax.stop_gradient(data)
+
+
+@defop("make_loss")
+def make_loss(data, grad_scale=1.0, valid_thresh=0.0, normalization="null"):
+    """Mark an output as a loss head (ref: src/operator/make_loss.cc)."""
+    return data * 1.0
+
+
+@defop("smooth_l1")
+def smooth_l1(data, scalar=1.0):
+    """Smooth-L1 (ref: elemwise_binary_scalar_op_extended.cc)."""
+    s2 = scalar * scalar
+    absd = jnp.abs(data)
+    return jnp.where(absd < 1.0 / s2, 0.5 * s2 * data * data,
+                     absd - 0.5 / s2)
+
+
+@defop("softrelu")
+def softrelu(data):
+    """log(1+exp(x)) — Activation act_type='softrelu'."""
+    return jax.nn.softplus(data)
+
+
+# --------------------------------------------------------------------------
+# elementwise binary (same-shape) + broadcasting variants
+# (ref: elemwise_binary_op_basic.cc, broadcast_reduce_op_value.cc)
+# jnp broadcasts natively, so both families share one emission.
+# --------------------------------------------------------------------------
+_BINARY = {
+    "add": jnp.add,
+    "sub": jnp.subtract,
+    "mul": jnp.multiply,
+    "div": jnp.divide,
+    "mod": jnp.mod,
+    "power": jnp.power,
+    "maximum": jnp.maximum,
+    "minimum": jnp.minimum,
+    "hypot": jnp.hypot,
+}
+
+_CMP = {
+    "equal": jnp.equal,
+    "not_equal": jnp.not_equal,
+    "greater": jnp.greater,
+    "greater_equal": jnp.greater_equal,
+    "lesser": jnp.less,
+    "lesser_equal": jnp.less_equal,
+}
+
+
+def _make_binary(name, f, cmp=False):
+    def _op(lhs, rhs, _f=f, _cmp=cmp):
+        out = _f(lhs, rhs)
+        if _cmp:
+            out = out.astype(jnp.result_type(lhs))
+        return out
+    _op.__name__ = name
+    _op.__doc__ = f"Elementwise/broadcast {name}."
+    return _op
+
+
+for _n, _f in _BINARY.items():
+    defop("broadcast_" + _n)(_make_binary("broadcast_" + _n, _f))
+for _n, _f in _CMP.items():
+    defop("broadcast_" + _n)(_make_binary("broadcast_" + _n, _f, cmp=True))
+    defop("_" + _n)(_make_binary("_" + _n, _f, cmp=True))
+
+alias("broadcast_add", "elemwise_add", "_add", "_plus", "broadcast_plus")
+alias("broadcast_sub", "elemwise_sub", "_sub", "_minus", "broadcast_minus")
+alias("broadcast_mul", "elemwise_mul", "_mul")
+alias("broadcast_div", "elemwise_div", "_div")
+alias("broadcast_mod", "_mod")
+alias("broadcast_power", "_power")
+alias("broadcast_maximum", "_maximum")
+alias("broadcast_minimum", "_minimum")
+alias("broadcast_hypot", "_hypot")
+
+
+@defop("elemwise_addto", differentiable=False)
+def elemwise_addto(lhs, rhs):
+    """In-place accumulate helper (kAddTo analog)."""
+    return lhs + rhs
+
+
+# --------------------------------------------------------------------------
+# scalar family (ref: elemwise_binary_scalar_op_basic.cc)
+# --------------------------------------------------------------------------
+_SCALAR = {
+    "_plus_scalar": lambda x, s: x + s,
+    "_minus_scalar": lambda x, s: x - s,
+    "_rminus_scalar": lambda x, s: s - x,
+    "_mul_scalar": lambda x, s: x * s,
+    "_div_scalar": lambda x, s: x / s,
+    "_rdiv_scalar": lambda x, s: s / x,
+    "_mod_scalar": lambda x, s: jnp.mod(x, s),
+    "_rmod_scalar": lambda x, s: jnp.mod(s, x),
+    "_power_scalar": lambda x, s: jnp.power(x, s),
+    "_rpower_scalar": lambda x, s: jnp.power(s, x),
+    "_maximum_scalar": lambda x, s: jnp.maximum(x, s),
+    "_minimum_scalar": lambda x, s: jnp.minimum(x, s),
+    "_hypot_scalar": lambda x, s: jnp.hypot(x, s),
+    "_equal_scalar": lambda x, s: (x == s).astype(x.dtype),
+    "_not_equal_scalar": lambda x, s: (x != s).astype(x.dtype),
+    "_greater_scalar": lambda x, s: (x > s).astype(x.dtype),
+    "_greater_equal_scalar": lambda x, s: (x >= s).astype(x.dtype),
+    "_lesser_scalar": lambda x, s: (x < s).astype(x.dtype),
+    "_lesser_equal_scalar": lambda x, s: (x <= s).astype(x.dtype),
+}
+
+
+def _make_scalar(name, f):
+    def _op(data, scalar=1.0, _f=f):
+        return _f(data, scalar)
+    _op.__name__ = name
+    _op.__doc__ = f"Scalar op {name}."
+    return _op
+
+
+for _n, _f in _SCALAR.items():
+    defop(_n)(_make_scalar(_n, _f))
+
+
+# logical
+@defop("logical_not")
+def logical_not(data):
+    return (data == 0).astype(data.dtype)
+
+
+for _n, _f in {"logical_and": jnp.logical_and,
+               "logical_or": jnp.logical_or,
+               "logical_xor": jnp.logical_xor}.items():
+    defop("broadcast_" + _n)(_make_binary("broadcast_" + _n, _f, cmp=True))
+
+
+# --------------------------------------------------------------------------
+# n-ary
+# --------------------------------------------------------------------------
+@defop("add_n", aliases=["ElementWiseSum", "_sparse_ElementWiseSum",
+                         "_sparse_add_n"], variadic=True)
+def add_n(*args):
+    """Sum of N tensors (ref: elemwise_sum.cc)."""
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out

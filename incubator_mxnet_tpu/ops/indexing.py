@@ -1,0 +1,73 @@
+"""Indexing ops (ref: src/operator/tensor/indexing_op.cc): Embedding,
+take, batch_take, one_hot, pick, gather_nd, scatter_nd.
+
+On TPU these lower to XLA gather/scatter HLOs (the reference needed
+CUB kernels; XLA emits them natively).
+"""
+import jax.numpy as jnp
+
+from .registry import defop, alias
+
+
+@defop("Embedding", aliases=["_contrib_SparseEmbedding"])
+def embedding(data, weight, input_dim=0, output_dim=0, dtype="float32",
+              sparse_grad=False):
+    """Row lookup into an (input_dim, output_dim) table."""
+    idx = data.astype(jnp.int32)
+    return jnp.take(weight, idx, axis=0)
+
+
+@defop("take")
+def take(a, indices, axis=0, mode="clip"):
+    idx = indices.astype(jnp.int32)
+    if mode == "wrap":
+        idx = jnp.mod(idx, a.shape[int(axis)])
+    return jnp.take(a, idx, axis=int(axis), mode="clip")
+
+
+@defop("batch_take")
+def batch_take(a, indices):
+    """a[i, indices[i]] (ref: indexing_op.cc batch_take)."""
+    idx = indices.astype(jnp.int32).reshape(-1)
+    return a[jnp.arange(a.shape[0]), idx]
+
+
+@defop("one_hot", differentiable=False)
+def one_hot(indices, depth=0, on_value=1.0, off_value=0.0, dtype="float32"):
+    from ..base import np_dtype
+    idx = indices.astype(jnp.int32)
+    eye = jnp.arange(int(depth), dtype=jnp.int32)
+    out = jnp.where(idx[..., None] == eye, on_value, off_value)
+    return out.astype(np_dtype(dtype))
+
+
+@defop("pick")
+def pick(data, index, axis=-1, keepdims=False, mode="clip"):
+    ax = int(axis) % data.ndim
+    idx = jnp.clip(index.astype(jnp.int32), 0, data.shape[ax] - 1)
+    picked = jnp.take_along_axis(data, jnp.expand_dims(idx, ax), axis=ax)
+    return picked if keepdims else jnp.squeeze(picked, ax)
+
+
+@defop("gather_nd")
+def gather_nd(data, indices):
+    """indices shape (M, ...) indexes the first M dims of data."""
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    return data[tuple(idx[i] for i in range(m))]
+
+
+@defop("scatter_nd")
+def scatter_nd(data, indices, shape=()):
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    out = jnp.zeros(tuple(int(s) for s in shape),
+                    dtype=jnp.result_type(data))
+    return out.at[tuple(idx[i] for i in range(m))].add(data)
+
+
+@defop("_scatter_set_nd")
+def _scatter_set_nd(lhs, rhs, indices, shape=()):
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    return lhs.at[tuple(idx[i] for i in range(m))].set(rhs)

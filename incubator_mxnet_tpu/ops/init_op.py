@@ -1,0 +1,38 @@
+"""Creation ops (ref: src/operator/tensor/init_op.cc)."""
+import jax.numpy as jnp
+
+from .registry import defop
+
+
+def _dt(dtype):
+    from ..base import np_dtype
+    return np_dtype(dtype or "float32")
+
+
+@defop("_zeros", aliases=["_sparse_zeros"], differentiable=False)
+def _zeros(shape=(), dtype="float32", ctx=None):
+    return jnp.zeros(tuple(int(s) for s in shape), _dt(dtype))
+
+
+@defop("_ones", differentiable=False)
+def _ones(shape=(), dtype="float32", ctx=None):
+    return jnp.ones(tuple(int(s) for s in shape), _dt(dtype))
+
+
+@defop("_full", differentiable=False)
+def _full(shape=(), value=0.0, dtype="float32", ctx=None):
+    return jnp.full(tuple(int(s) for s in shape), value, _dt(dtype))
+
+
+@defop("_arange", differentiable=False)
+def _arange(start=0.0, stop=None, step=1.0, repeat=1, dtype="float32",
+            ctx=None, infer_range=False):
+    out = jnp.arange(start, stop, step, _dt(dtype))
+    if int(repeat) != 1:
+        out = jnp.repeat(out, int(repeat))
+    return out
+
+
+@defop("_eye", differentiable=False)
+def _eye(N=0, M=0, k=0, dtype="float32", ctx=None):
+    return jnp.eye(int(N), int(M) or None, int(k), dtype=_dt(dtype))

@@ -1,0 +1,97 @@
+"""Linear-algebra ops (ref: src/operator/tensor/la_op.cc — the LAPACK
+bridge ops _linalg_*).  XLA provides these natively on TPU.
+"""
+import jax.numpy as jnp
+from jax import scipy as jsp
+
+from .registry import defop, alias
+
+
+@defop("_linalg_gemm", aliases=["linalg_gemm"])
+def linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0,
+                beta=1.0, axis=-2):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b) + beta * C
+
+
+@defop("_linalg_gemm2", aliases=["linalg_gemm2"])
+def linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0,
+                 axis=-2):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b)
+
+
+@defop("_linalg_potrf", aliases=["linalg_potrf"])
+def linalg_potrf(A):
+    """Cholesky factor (lower) (ref: la_op.cc potrf)."""
+    return jnp.linalg.cholesky(A)
+
+
+@defop("_linalg_potri", aliases=["linalg_potri"])
+def linalg_potri(A):
+    """Inverse from Cholesky factor: inv(L L^T)."""
+    eye = jnp.broadcast_to(jnp.eye(A.shape[-1], dtype=A.dtype), A.shape)
+    linv = jsp.linalg.solve_triangular(A, eye, lower=True)
+    return jnp.matmul(jnp.swapaxes(linv, -1, -2), linv)
+
+
+@defop("_linalg_trmm", aliases=["linalg_trmm"])
+def linalg_trmm(A, B, transpose=False, rightside=False, lower=True,
+                alpha=1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose else A
+    out = jnp.matmul(B, a) if rightside else jnp.matmul(a, B)
+    return alpha * out
+
+
+@defop("_linalg_trsm", aliases=["linalg_trsm"])
+def linalg_trsm(A, B, transpose=False, rightside=False, lower=True,
+                alpha=1.0):
+    if rightside:
+        if transpose:
+            # solve X A^T = alpha B  ->  A X^T = alpha B^T
+            xt = jsp.linalg.solve_triangular(
+                A, jnp.swapaxes(B, -1, -2), lower=lower)
+        else:
+            # solve X A = alpha B  ->  A^T X^T = alpha B^T
+            xt = jsp.linalg.solve_triangular(
+                jnp.swapaxes(A, -1, -2), jnp.swapaxes(B, -1, -2),
+                lower=not lower)
+        return alpha * jnp.swapaxes(xt, -1, -2)
+    return alpha * jsp.linalg.solve_triangular(
+        A, B, lower=lower, trans=1 if transpose else 0)
+
+
+@defop("_linalg_sumlogdiag", aliases=["linalg_sumlogdiag"])
+def linalg_sumlogdiag(A):
+    return jnp.sum(jnp.log(jnp.diagonal(A, axis1=-2, axis2=-1)), axis=-1)
+
+
+@defop("_linalg_syrk", aliases=["linalg_syrk"])
+def linalg_syrk(A, transpose=False, alpha=1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose else A
+    return alpha * jnp.matmul(a, jnp.swapaxes(a, -1, -2))
+
+
+@defop("_linalg_syevd", aliases=["linalg_syevd"], num_outputs=2)
+def linalg_syevd(A):
+    w, v = jnp.linalg.eigh(A)
+    return jnp.swapaxes(v, -1, -2), w
+
+
+@defop("_linalg_gelqf", aliases=["linalg_gelqf"], num_outputs=2)
+def linalg_gelqf(A):
+    """LQ factorization via QR of A^T (ref: la_op.cc gelqf)."""
+    q, r = jnp.linalg.qr(jnp.swapaxes(A, -1, -2), mode="reduced")
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+
+@defop("khatri_rao", variadic=True)
+def khatri_rao(*args):
+    """Column-wise Khatri-Rao product (ref: contrib/krprod.h)."""
+    out = args[0]
+    for b in args[1:]:
+        out = jnp.einsum("ik,jk->ijk", out, b).reshape(
+            (-1, out.shape[-1]))
+    return out

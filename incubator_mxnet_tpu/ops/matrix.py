@@ -1,0 +1,260 @@
+"""Shape-manipulation and linear-algebra ops (ref:
+src/operator/tensor/matrix_op.cc, dot.cc, concat.cc, slice_channel.cc,
+swapaxis.cc, pad.cc, crop.cc, control_flow_op.cc, init_op.cc cast).
+
+On TPU, `dot`/`batch_dot` are the MXU ops; everything else is layout
+work that XLA folds into surrounding fusions.
+"""
+import jax
+import jax.numpy as jnp
+
+from .registry import defop, alias
+
+
+# ------------------------------------------------------------------ reshape
+@defop("Reshape", aliases=["reshape"])
+def reshape(data, shape=(), reverse=False):
+    """Reshape with the reference's special codes 0, -1, -2, -3, -4
+    (ref: matrix_op-inl.h ReshapeParam)."""
+    src = list(data.shape)
+    if reverse:
+        src = src[::-1]
+        shape = tuple(shape)[::-1]
+    out, i = [], 0
+    it = iter(range(len(shape)))
+    shape = list(shape)
+    k = 0
+    while k < len(shape):
+        s = shape[k]
+        if s == 0:
+            out.append(src[i]); i += 1
+        elif s == -1:
+            out.append(-1); i += 1
+        elif s == -2:
+            out.extend(src[i:]); i = len(src)
+        elif s == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif s == -4:
+            d1, d2 = shape[k + 1], shape[k + 2]
+            if d1 == -1:
+                d1 = src[i] // d2
+            if d2 == -1:
+                d2 = src[i] // d1
+            out.extend([d1, d2]); i += 1; k += 2
+        else:
+            out.append(int(s)); i += 1
+        k += 1
+    if reverse:
+        out = out[::-1]
+    return data.reshape(tuple(out))
+
+
+@defop("Flatten", aliases=["flatten"])
+def flatten(data):
+    """Collapse all dims but the first (ref: matrix_op.cc Flatten)."""
+    return data.reshape((data.shape[0], -1))
+
+
+@defop("transpose")
+def transpose(data, axes=()):
+    ax = tuple(axes) if axes else None
+    return jnp.transpose(data, ax)
+
+
+@defop("expand_dims")
+def expand_dims(data, axis=0):
+    return jnp.expand_dims(data, int(axis))
+
+
+@defop("SwapAxis", aliases=["swapaxes"])
+def swapaxes(data, dim1=0, dim2=0):
+    return jnp.swapaxes(data, int(dim1), int(dim2))
+
+
+@defop("squeeze")
+def squeeze(data, axis=None):
+    ax = None if axis is None else (
+        (int(axis),) if isinstance(axis, int) else tuple(axis))
+    return jnp.squeeze(data, ax)
+
+
+# ------------------------------------------------------------------ slicing
+def _slice_tuple(begin, end, step, ndim, shape):
+    begin = list(begin) + [None] * (ndim - len(begin))
+    end = list(end) + [None] * (ndim - len(end))
+    step = (list(step) + [None] * (ndim - len(step))) if step else [None] * ndim
+    sl = []
+    for b, e, s in zip(begin, end, step):
+        sl.append(slice(b, e, s))
+    return tuple(sl)
+
+
+@defop("slice", aliases=["crop"])
+def slice_op(data, begin=(), end=(), step=()):
+    """Python-slicing semantics slice (ref: matrix_op.cc slice)."""
+    return data[_slice_tuple(begin, end, step, data.ndim, data.shape)]
+
+
+@defop("slice_axis")
+def slice_axis(data, axis=0, begin=0, end=None):
+    axis = int(axis) % data.ndim
+    sl = [slice(None)] * data.ndim
+    sl[axis] = slice(begin, end)
+    return data[tuple(sl)]
+
+
+@defop("slice_like")
+def slice_like(data, shape_like, axes=()):
+    axes_ = tuple(axes) if axes else tuple(range(shape_like.ndim))
+    sl = [slice(None)] * data.ndim
+    for a in axes_:
+        sl[a % data.ndim] = slice(0, shape_like.shape[a % shape_like.ndim])
+    return data[tuple(sl)]
+
+
+@defop("_slice_assign", aliases=["_crop_assign"])
+def _slice_assign(lhs, rhs, begin=(), end=(), step=()):
+    return lhs.at[_slice_tuple(begin, end, step, lhs.ndim, lhs.shape)].set(rhs)
+
+
+@defop("_slice_assign_scalar", aliases=["_crop_assign_scalar"])
+def _slice_assign_scalar(data, scalar=0.0, begin=(), end=(), step=()):
+    sl = _slice_tuple(begin, end, step, data.ndim, data.shape)
+    return data.at[sl].set(jnp.asarray(scalar, data.dtype))
+
+
+@defop("clip")
+def clip(data, a_min=0.0, a_max=1.0):
+    return jnp.clip(data, a_min, a_max)
+
+
+@defop("repeat")
+def repeat(data, repeats=1, axis=None):
+    return jnp.repeat(data, int(repeats),
+                      axis=None if axis is None else int(axis))
+
+
+@defop("tile")
+def tile(data, reps=()):
+    return jnp.tile(data, tuple(reps))
+
+
+@defop("reverse", aliases=["flip"])
+def reverse(data, axis=()):
+    ax = (axis,) if isinstance(axis, int) else tuple(axis)
+    return jnp.flip(data, ax)
+
+
+# ------------------------------------------------------------- concat/split
+@defop("Concat", aliases=["concat"], variadic=True)
+def concat(*args, dim=1, num_args=None):
+    """Concatenate along ``dim`` (ref: src/operator/concat.cc)."""
+    return jnp.concatenate(args, axis=int(dim))
+
+
+@defop("stack", variadic=True)
+def stack(*args, axis=0, num_args=None):
+    return jnp.stack(args, axis=int(axis))
+
+
+def _split_outputs(params):
+    return int(params.get("num_outputs", 1))
+
+
+@defop("SliceChannel", aliases=["split"], num_outputs=_split_outputs)
+def slice_channel(data, num_outputs=1, axis=1, squeeze_axis=False):
+    """Split into equal parts (ref: slice_channel.cc)."""
+    parts = jnp.split(data, int(num_outputs), axis=int(axis))
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, int(axis)) for p in parts]
+    return tuple(parts) if len(parts) > 1 else parts[0]
+
+
+# ------------------------------------------------------------------ matmul
+@defop("dot", aliases=["_sparse_dot"])
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Matrix product on the MXU (ref: src/operator/tensor/dot.cc).
+
+    For >2-D inputs follows the reference: reshape lhs to
+    (prod(head), last) and rhs to (first, prod(tail)).
+    """
+    a = lhs.T if transpose_a else lhs
+    b = rhs.T if transpose_b else rhs
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    a2 = a.reshape((-1, a.shape[-1]))
+    b2 = b.reshape((b.shape[0], -1))
+    out = jnp.dot(a2, b2, preferred_element_type=jnp.result_type(a2))
+    return out.reshape(a.shape[:-1] + b.shape[1:])
+
+
+@defop("batch_dot")
+def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Batched matmul (ref: dot.cc batch_dot)."""
+    a = jnp.swapaxes(lhs, -1, -2) if transpose_a else lhs
+    b = jnp.swapaxes(rhs, -1, -2) if transpose_b else rhs
+    return jnp.matmul(a, b)
+
+
+# ------------------------------------------------------------------ pad
+@defop("Pad", aliases=["pad"])
+def pad(data, mode="constant", pad_width=(), constant_value=0.0):
+    """Pad NCHW/NCDHW (ref: src/operator/pad.cc). pad_width is the
+    flat (before, after) per-axis list like the reference."""
+    pw = list(pad_width)
+    pairs = [(int(pw[2 * i]), int(pw[2 * i + 1]))
+             for i in range(len(pw) // 2)]
+    while len(pairs) < data.ndim:
+        pairs.append((0, 0))
+    if mode == "constant":
+        return jnp.pad(data, pairs, constant_values=constant_value)
+    if mode == "edge":
+        return jnp.pad(data, pairs, mode="edge")
+    if mode == "reflect":
+        return jnp.pad(data, pairs, mode="reflect")
+    raise ValueError(f"unknown pad mode {mode}")
+
+
+# ------------------------------------------------------------------ where
+@defop("where")
+def where(condition, x, y):
+    """Elementwise select (ref: control_flow_op.cc where)."""
+    if condition.ndim == 1 and x.ndim > 1:
+        condition = condition.reshape((-1,) + (1,) * (x.ndim - 1))
+    return jnp.where(condition != 0, x, y)
+
+
+# ------------------------------------------------------------------ casts
+@defop("Cast", aliases=["cast"])
+def cast(data, dtype="float32"):
+    from ..base import np_dtype
+    return data.astype(np_dtype(dtype))
+
+
+@defop("amp_cast")
+def amp_cast(data, dtype="float16"):
+    from ..base import np_dtype
+    return data.astype(np_dtype(dtype))
+
+
+@defop("zeros_like", aliases=["_sparse_zeros_like"])
+def zeros_like(data):
+    return jnp.zeros_like(data)
+
+
+@defop("ones_like")
+def ones_like(data):
+    return jnp.ones_like(data)
+
+
+@defop("_identity_with_attr_like_rhs")
+def _identity_with_attr_like_rhs(lhs, rhs):
+    return lhs + 0
+
+
+@defop("_CrossDeviceCopy", aliases=["_cross_device_copy"])
+def cross_device_copy(data):
+    """Explicit device boundary marker (ref: cross_device_copy.cc).
+    Under jit this is an identity; placement is handled by sharding
+    annotations instead of graph-inserted copy nodes."""
+    return data + 0

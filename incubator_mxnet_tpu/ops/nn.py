@@ -1,0 +1,646 @@
+"""Neural-network layer ops.
+
+TPU-native re-design of the reference's layer zoo (ref:
+src/operator/fully_connected.cc, convolution.cc, pooling.cc,
+batch_norm.cc, activation.cc, dropout.cc, softmax_output.cc,
+lrn.cc, l2_normalization.cc, instance_norm.cc, upsampling.cc,
+sequence_{mask,last,reverse}.cc, regression_output.cc, svm_output.cc).
+
+Convs/matmuls emit `lax.conv_general_dilated` / `jnp.dot` — the MXU
+path; the cuDNN bindings of the reference have no analog because XLA
+*is* the kernel library.  Stateful layers (BatchNorm moving stats)
+surface as `num_aux` ops whose updated aux values are returned
+functionally and written back by the frontend — the jit-safe version
+of the reference's in-place aux mutation.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .registry import defop
+
+# ---------------------------------------------------------------------------
+# dense / conv / pool
+# ---------------------------------------------------------------------------
+
+
+@defop("FullyConnected")
+def fully_connected(data, weight, bias=None, num_hidden=0, no_bias=False,
+                    flatten=True):
+    """y = x W^T + b (ref: src/operator/fully_connected.cc)."""
+    x = data.reshape((data.shape[0], -1)) if flatten else data
+    out = jnp.dot(x, weight.T, preferred_element_type=jnp.result_type(x))
+    if bias is not None and not no_bias:
+        out = out + bias
+    return out
+
+
+def _conv_specs(ndim_spatial):
+    if ndim_spatial == 1:
+        return ("NCW", "OIW", "NCW")
+    if ndim_spatial == 2:
+        return ("NCHW", "OIHW", "NCHW")
+    return ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _tup(v, n, default):
+    if v is None or v == ():
+        return (default,) * n
+    if isinstance(v, int):
+        return (v,) * n
+    t = tuple(int(x) for x in v)
+    return t if len(t) == n else t + (default,) * (n - len(t))
+
+
+@defop("Convolution", aliases=["Convolution_v1"])
+def convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
+                pad=(), num_filter=0, num_group=1, workspace=1024,
+                no_bias=False, cudnn_tune=None, cudnn_off=False,
+                layout=None):
+    """N-D convolution, NC(D)HW layout (ref: convolution.cc).
+
+    Lowers to one `lax.conv_general_dilated` — the MXU systolic path.
+    """
+    nsp = data.ndim - 2
+    stride = _tup(stride, nsp, 1)
+    dilate = _tup(dilate, nsp, 1)
+    pad = _tup(pad, nsp, 0)
+    dn = jax.lax.conv_dimension_numbers(
+        data.shape, weight.shape, _conv_specs(nsp))
+    out = jax.lax.conv_general_dilated(
+        data, weight, window_strides=stride,
+        padding=[(p, p) for p in pad], rhs_dilation=dilate,
+        dimension_numbers=dn, feature_group_count=int(num_group),
+        preferred_element_type=jnp.result_type(data))
+    if bias is not None and not no_bias:
+        out = out + bias.reshape((1, -1) + (1,) * nsp)
+    return out
+
+
+@defop("Deconvolution")
+def deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
+                  pad=(), adj=(), target_shape=(), num_filter=0,
+                  num_group=1, workspace=1024, no_bias=True,
+                  cudnn_tune=None, cudnn_off=False, layout=None):
+    """Transposed convolution (ref: deconvolution.cc).
+
+    Implemented as input-dilated convolution with the spatially-flipped,
+    IO-swapped kernel — the canonical XLA formulation.
+    """
+    nsp = data.ndim - 2
+    stride = _tup(stride, nsp, 1)
+    dilate = _tup(dilate, nsp, 1)
+    pad = _tup(pad, nsp, 0)
+    adj = _tup(adj, nsp, 0)
+    k = weight.shape[2:]
+    # weight layout is (in, out/group, *k) for deconv in the reference
+    w = jnp.flip(weight, axis=tuple(range(2, weight.ndim)))
+    g = int(num_group)
+    if g > 1:
+        # (g*in_pg, out_pg, *k) -> (g*out_pg, in_pg, *k)
+        in_pg = w.shape[0] // g
+        w = w.reshape((g, in_pg, w.shape[1]) + k)
+        w = jnp.swapaxes(w, 1, 2)
+        w = w.reshape((g * w.shape[1], in_pg) + k)
+    else:
+        w = jnp.swapaxes(w, 0, 1)
+    eff_k = [dilate[i] * (k[i] - 1) + 1 for i in range(nsp)]
+    padding = [(eff_k[i] - 1 - pad[i], eff_k[i] - 1 - pad[i] + adj[i])
+               for i in range(nsp)]
+    dn = jax.lax.conv_dimension_numbers(
+        data.shape, w.shape, _conv_specs(nsp))
+    out = jax.lax.conv_general_dilated(
+        data, w, window_strides=(1,) * nsp, padding=padding,
+        lhs_dilation=stride, rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=g,
+        preferred_element_type=jnp.result_type(data))
+    if bias is not None and not no_bias:
+        out = out + bias.reshape((1, -1) + (1,) * nsp)
+    return out
+
+
+@defop("Pooling", aliases=["Pooling_v1"])
+def pooling(data, kernel=(), pool_type="max", global_pool=False,
+            stride=(), pad=(), pooling_convention="valid",
+            cudnn_off=False):
+    """Max/avg/sum pooling via reduce_window (ref: pooling.cc, nn/pool.h)."""
+    nsp = data.ndim - 2
+    if global_pool:
+        kernel = data.shape[2:]
+        stride = (1,) * nsp
+        pad = (0,) * nsp
+    kernel = _tup(kernel, nsp, 1)
+    stride = _tup(stride, nsp, 1)
+    pad = _tup(pad, nsp, 0)
+    padding = []
+    for i in range(nsp):
+        lo = hi = pad[i]
+        if pooling_convention == "full":
+            size = data.shape[2 + i] + 2 * pad[i] - kernel[i]
+            rem = size % stride[i]
+            if rem != 0:
+                hi += stride[i] - rem
+        padding.append((lo, hi))
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    pads = [(0, 0), (0, 0)] + padding
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) \
+            else jnp.iinfo(data.dtype).min
+        return jax.lax.reduce_window(data, jnp.asarray(init, data.dtype),
+                                     jax.lax.max, window, strides, pads)
+    summed = jax.lax.reduce_window(
+        data, jnp.asarray(0, data.dtype), jax.lax.add, window, strides,
+        pads)
+    if pool_type == "sum":
+        return summed
+    if pool_type == "avg":
+        denom = 1
+        for ki in kernel:
+            denom *= ki
+        return summed / jnp.asarray(denom, data.dtype)
+    raise ValueError(f"unknown pool_type {pool_type}")
+
+
+@defop("UpSampling", variadic=True)
+def upsampling(*args, scale=1, sample_type="nearest", num_filter=0,
+               multi_input_mode="concat", num_args=1, workspace=512):
+    """Nearest/bilinear upsampling (ref: upsampling.cc)."""
+    s = int(scale)
+    outs = []
+    for data in args[:1] if sample_type == "bilinear" else args:
+        if sample_type == "nearest":
+            out = jnp.repeat(jnp.repeat(data, s, axis=2), s, axis=3)
+        else:
+            n, c, h, w = data.shape
+            out = jax.image.resize(data, (n, c, h * s, w * s), "bilinear")
+        outs.append(out)
+    if len(outs) == 1:
+        return outs[0]
+    return jnp.concatenate(outs, axis=1)
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+
+
+@defop("BatchNorm", aliases=["BatchNorm_v1", "CuDNNBatchNorm"],
+       needs_mode=True, num_aux=2,
+       arg_names=["data", "gamma", "beta"],
+       aux_names=["moving_mean", "moving_var"])
+def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
+               momentum=0.9, fix_gamma=True, use_global_stats=False,
+               output_mean_var=False, axis=1, cudnn_off=False,
+               _training=False):
+    """Batch normalization (ref: src/operator/batch_norm.cc).
+
+    Functional aux protocol: in training mode returns
+    (out, new_moving_mean, new_moving_var); the frontend writes the
+    updated stats back into the aux arrays (jit-safe replacement for
+    the reference's in-place aux mutation).
+    """
+    ax = int(axis) % data.ndim
+    red = tuple(i for i in range(data.ndim) if i != ax)
+    bshape = [1] * data.ndim
+    bshape[ax] = data.shape[ax]
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    if _training and not use_global_stats:
+        mean = jnp.mean(data, axis=red)
+        var = jnp.var(data, axis=red)
+        new_mean = (momentum * moving_mean
+                    + (1 - momentum) * jax.lax.stop_gradient(mean))
+        new_var = (momentum * moving_var
+                   + (1 - momentum) * jax.lax.stop_gradient(var))
+    else:
+        mean, var = moving_mean, moving_var
+        new_mean, new_var = moving_mean, moving_var
+    inv = jax.lax.rsqrt(var + eps)
+    out = ((data - mean.reshape(bshape)) * inv.reshape(bshape)
+           * g.reshape(bshape) + beta.reshape(bshape))
+    if _training:
+        return out, new_mean, new_var
+    return out
+
+
+@defop("LayerNorm")
+def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
+    """Layer normalization over ``axis``."""
+    ax = int(axis) % data.ndim
+    mean = jnp.mean(data, axis=ax, keepdims=True)
+    var = jnp.var(data, axis=ax, keepdims=True)
+    shape = [1] * data.ndim
+    shape[ax] = data.shape[ax]
+    out = ((data - mean) * jax.lax.rsqrt(var + eps)
+           * gamma.reshape(shape) + beta.reshape(shape))
+    return out
+
+
+@defop("InstanceNorm")
+def instance_norm(data, gamma, beta, eps=1e-3):
+    """Instance norm over spatial dims (ref: instance_norm.cc)."""
+    red = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=red, keepdims=True)
+    var = jnp.var(data, axis=red, keepdims=True)
+    shape = (1, -1) + (1,) * (data.ndim - 2)
+    return ((data - mean) * jax.lax.rsqrt(var + eps)
+            * gamma.reshape(shape) + beta.reshape(shape))
+
+
+@defop("L2Normalization")
+def l2_normalization(data, eps=1e-10, mode="instance"):
+    """(ref: l2_normalization.cc) modes instance/channel/spatial."""
+    if mode == "instance":
+        red = tuple(range(1, data.ndim))
+        n = jnp.sqrt(jnp.sum(jnp.square(data), axis=red, keepdims=True)
+                     + eps)
+    elif mode == "channel":
+        n = jnp.sqrt(jnp.sum(jnp.square(data), axis=1, keepdims=True)
+                     + eps)
+    elif mode == "spatial":
+        red = tuple(range(2, data.ndim))
+        n = jnp.sqrt(jnp.sum(jnp.square(data), axis=red, keepdims=True)
+                     + eps)
+    else:
+        raise ValueError(mode)
+    return data / n
+
+
+@defop("LRN")
+def lrn(data, nsize=5, alpha=1e-4, beta=0.75, knorm=2.0):
+    """Local response normalization across channels (ref: lrn.cc)."""
+    n = int(nsize)
+    sq = jnp.square(data)
+    pad_lo, pad_hi = (n - 1) // 2, n // 2
+    window = (1, n, 1, 1)
+    acc = jax.lax.reduce_window(
+        sq, jnp.asarray(0, data.dtype), jax.lax.add, window,
+        (1, 1, 1, 1), [(0, 0), (pad_lo, pad_hi), (0, 0), (0, 0)])
+    return data / jnp.power(knorm + alpha / n * acc, beta)
+
+
+# ---------------------------------------------------------------------------
+# activations / softmax
+# ---------------------------------------------------------------------------
+
+
+@defop("Activation")
+def activation(data, act_type="relu"):
+    """(ref: activation.cc) relu/sigmoid/tanh/softrelu/softsign."""
+    if act_type == "relu":
+        return jnp.maximum(data, 0)
+    if act_type == "sigmoid":
+        return jax.nn.sigmoid(data)
+    if act_type == "tanh":
+        return jnp.tanh(data)
+    if act_type == "softrelu":
+        return jax.nn.softplus(data)
+    if act_type == "softsign":
+        return data / (1 + jnp.abs(data))
+    raise ValueError(f"unknown act_type {act_type}")
+
+
+@defop("LeakyReLU", variadic=True, needs_rng=True, needs_mode=True)
+def leaky_relu(*args, act_type="leaky", slope=0.25, lower_bound=0.125,
+               upper_bound=0.334, _rng=None, _training=False):
+    """(ref: leaky_relu.cc) leaky/prelu/elu/selu/rrelu."""
+    data = args[0]
+    if act_type == "leaky":
+        return jnp.where(data > 0, data, slope * data)
+    if act_type == "prelu":
+        gamma = args[1].reshape((1, -1) + (1,) * (data.ndim - 2))
+        return jnp.where(data > 0, data, gamma * data)
+    if act_type == "elu":
+        return jnp.where(data > 0, data, slope * jnp.expm1(data))
+    if act_type == "selu":
+        scale, a = 1.0507009873554805, 1.6732632423543772
+        return scale * jnp.where(data > 0, data, a * jnp.expm1(data))
+    if act_type == "rrelu":
+        if _training and _rng is not None:
+            s = jax.random.uniform(_rng, data.shape, data.dtype,
+                                   lower_bound, upper_bound)
+        else:
+            s = (lower_bound + upper_bound) / 2.0
+        return jnp.where(data > 0, data, s * data)
+    raise ValueError(f"unknown act_type {act_type}")
+
+
+@defop("softmax", aliases=["SoftmaxActivation"])
+def softmax(data, axis=-1, temperature=None, mode="instance"):
+    """(ref: nn/softmax.cc; SoftmaxActivation mode=channel -> axis=1)."""
+    ax = 1 if mode == "channel" else int(axis)
+    x = data / temperature if temperature else data
+    return jax.nn.softmax(x, axis=ax)
+
+
+@defop("log_softmax")
+def log_softmax(data, axis=-1, temperature=None):
+    x = data / temperature if temperature else data
+    return jax.nn.log_softmax(x, axis=int(axis))
+
+
+@defop("softmax_cross_entropy")
+def softmax_cross_entropy(data, label):
+    """Summed CE with integer labels (ref: loss_binary_op.cc)."""
+    logp = jax.nn.log_softmax(data, axis=-1)
+    picked = jnp.take_along_axis(
+        logp, label.astype(jnp.int32)[:, None], axis=-1)
+    return -jnp.sum(picked)
+
+
+# ---------------------------------------------------------------------------
+# output heads with implicit-loss gradients (custom VJP: the forward is
+# identity/softmax but the backward is the loss gradient — exactly the
+# reference's Output-op contract)
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _softmax_output_fn(grad_scale, ignore_label, multi_output, use_ignore,
+                       preserve_shape, normalization):
+    @jax.custom_vjp
+    def f(data, label):
+        ax = 1 if (multi_output or preserve_shape) else -1
+        return jax.nn.softmax(data, axis=ax)
+
+    def fwd(data, label):
+        out = f(data, label)
+        return out, (out, label)
+
+    def bwd(res, g):
+        out, label = res
+        ax = 1 if (multi_output or preserve_shape) else -1
+        lbl = label.astype(jnp.int32)
+        onehot = jax.nn.one_hot(lbl, out.shape[ax], dtype=out.dtype,
+                                axis=ax)
+        grad = out - onehot
+        if use_ignore:
+            mask = (lbl != int(ignore_label)).astype(out.dtype)
+            mask = jnp.expand_dims(mask, ax)
+            grad = grad * mask
+        scale = grad_scale
+        if normalization == "batch":
+            scale = scale / out.shape[0]
+        elif normalization == "valid" and use_ignore:
+            valid = jnp.maximum(
+                jnp.sum((lbl != int(ignore_label)).astype(out.dtype)), 1.0)
+            grad = grad / valid
+        grad = grad * scale
+        return grad, jnp.zeros_like(label)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+@defop("SoftmaxOutput", aliases=["Softmax"])
+def softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
+                   multi_output=False, use_ignore=False,
+                   preserve_shape=False, normalization="null",
+                   out_grad=False, smooth_alpha=0.0):
+    """Softmax forward; cross-entropy gradient in backward
+    (ref: src/operator/softmax_output.cc)."""
+    f = _softmax_output_fn(float(grad_scale), float(ignore_label),
+                           bool(multi_output), bool(use_ignore),
+                           bool(preserve_shape), str(normalization))
+    return f(data, label)
+
+
+def _make_regression(name, grad_fn):
+    @functools.lru_cache(maxsize=None)
+    def _build(grad_scale, link):
+        @jax.custom_vjp
+        def f(data, label):
+            return link(data)
+
+        def fwd(data, label):
+            out = f(data, label)
+            return out, (out, label)
+
+        def bwd(res, g):
+            out, label = res
+            num = 1
+            for s in out.shape[1:]:
+                num *= s
+            grad = grad_fn(out, label.reshape(out.shape)) * (
+                grad_scale / num)
+            return grad, jnp.zeros_like(label)
+
+        f.defvjp(fwd, bwd)
+        return f
+
+    def _op(data, label, grad_scale=1.0):
+        return _build(float(grad_scale), _LINKS[name])(data, label)
+    _op.__name__ = name
+    _op.__doc__ = f"{name} (ref: regression_output.cc)."
+    return _op
+
+
+_LINKS = {
+    "LinearRegressionOutput": lambda x: x,
+    "MAERegressionOutput": lambda x: x,
+    "LogisticRegressionOutput": jax.nn.sigmoid,
+}
+
+defop("LinearRegressionOutput")(_make_regression(
+    "LinearRegressionOutput", lambda o, l: o - l))
+defop("MAERegressionOutput")(_make_regression(
+    "MAERegressionOutput", lambda o, l: jnp.sign(o - l)))
+defop("LogisticRegressionOutput")(_make_regression(
+    "LogisticRegressionOutput", lambda o, l: o - l))
+
+
+@functools.lru_cache(maxsize=None)
+def _svm_output_fn(margin, reg, use_linear):
+    @jax.custom_vjp
+    def f(d, l):
+        return d * 1.0
+
+    def fwd(d, l):
+        return f(d, l), (d, l)
+
+    def bwd(res, g):
+        d, l = res
+        lbl = l.astype(jnp.int32)
+        onehot = jax.nn.one_hot(lbl, d.shape[-1], dtype=d.dtype)
+        ind = onehot * 2 - 1  # +1 at label, -1 elsewhere
+        viol = (margin - d * ind) > 0
+        if use_linear:
+            grad = jnp.where(viol, -ind * reg, 0.0)
+        else:
+            grad = jnp.where(viol, -2.0 * reg * (margin - d * ind) * ind,
+                             0.0)
+        return grad.astype(d.dtype), jnp.zeros_like(l)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+@defop("SVMOutput")
+def svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
+               use_linear=False):
+    """(ref: svm_output.cc) identity forward, hinge-loss backward."""
+    f = _svm_output_fn(float(margin), float(regularization_coefficient),
+                       bool(use_linear))
+    return f(data, label)
+
+
+# ---------------------------------------------------------------------------
+# dropout
+# ---------------------------------------------------------------------------
+
+
+@defop("Dropout", needs_rng=True, needs_mode=True)
+def dropout(data, p=0.5, mode="training", axes=(), _rng=None,
+            _training=False):
+    """Inverted dropout (ref: src/operator/dropout.cc)."""
+    if not _training and mode != "always":
+        return data * 1.0
+    if p <= 0.0 or _rng is None:
+        return data * 1.0
+    shape = list(data.shape)
+    for a in (axes or ()):
+        shape[a] = 1
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(_rng, keep, tuple(shape))
+    return jnp.where(mask, data / keep, 0.0).astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# sequence ops (ref: sequence_mask.cc / last.cc / reverse.cc)
+# ---------------------------------------------------------------------------
+
+
+@defop("SequenceMask", variadic=True)
+def sequence_mask(*args, use_sequence_length=False, value=0.0, axis=0):
+    """Mask positions beyond per-batch lengths. data is (T,B,...) when
+    axis=0 or (B,T,...) when axis=1."""
+    data = args[0]
+    if not use_sequence_length:
+        return data * 1.0
+    seqlen = args[1]
+    T = data.shape[int(axis)]
+    t = jnp.arange(T)
+    if int(axis) == 0:
+        mask = t[:, None] < seqlen[None, :].astype(t.dtype)
+    else:
+        mask = t[None, :] < seqlen[:, None].astype(t.dtype)
+    mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, jnp.asarray(value, data.dtype))
+
+
+@defop("SequenceLast", variadic=True)
+def sequence_last(*args, use_sequence_length=False, axis=0):
+    """Select last valid timestep (ref: sequence_last.cc)."""
+    data = args[0]
+    ax = int(axis)
+    if not use_sequence_length:
+        return jnp.take(data, data.shape[ax] - 1, axis=ax)
+    seqlen = args[1].astype(jnp.int32)
+    idx = jnp.clip(seqlen - 1, 0, data.shape[ax] - 1)
+    if ax == 0:
+        d = jnp.moveaxis(data, 0, 1)  # (B,T,...)
+    else:
+        d = data
+    return jnp.take_along_axis(
+        d, idx.reshape((-1, 1) + (1,) * (d.ndim - 2)), axis=1
+    ).squeeze(1)
+
+
+@defop("SequenceReverse", variadic=True)
+def sequence_reverse(*args, use_sequence_length=False, axis=0):
+    """Reverse along time (T,B,...) honoring lengths (ref:
+    sequence_reverse.cc)."""
+    data = args[0]
+    T = data.shape[0]
+    if not use_sequence_length:
+        return jnp.flip(data, 0)
+    seqlen = args[1].astype(jnp.int32)
+    t = jnp.arange(T)[:, None]
+    idx = jnp.where(t < seqlen[None, :], seqlen[None, :] - 1 - t, t)
+    b = jnp.arange(data.shape[1])[None, :]
+    return data[idx, b]
+
+
+# ---------------------------------------------------------------------------
+# spatial ops
+# ---------------------------------------------------------------------------
+
+
+@defop("GridGenerator")
+def grid_generator(data, transform_type="affine", target_shape=(0, 0)):
+    """Affine/warp sampling-grid generation (ref: grid_generator.cc)."""
+    h, w = int(target_shape[0]), int(target_shape[1])
+    if transform_type == "affine":
+        n = data.shape[0]
+        theta = data.reshape((n, 2, 3))
+        ys = jnp.linspace(-1.0, 1.0, h)
+        xs = jnp.linspace(-1.0, 1.0, w)
+        gx, gy = jnp.meshgrid(xs, ys)
+        ones = jnp.ones_like(gx)
+        coords = jnp.stack([gx.ravel(), gy.ravel(), ones.ravel()], 0)
+        out = jnp.einsum("nij,jk->nik", theta, coords)
+        return out.reshape((n, 2, h, w))
+    # warp: data is (n,2,h,w) flow field
+    n, _, hh, ww = data.shape
+    ys = jnp.arange(hh, dtype=data.dtype)
+    xs = jnp.arange(ww, dtype=data.dtype)
+    gx, gy = jnp.meshgrid(xs, ys)
+    x = (data[:, 0] + gx) * 2.0 / max(ww - 1, 1) - 1.0
+    y = (data[:, 1] + gy) * 2.0 / max(hh - 1, 1) - 1.0
+    return jnp.stack([x, y], 1)
+
+
+def _bilinear_sample(data, grid):
+    """Shared bilinear sampling core: grid is (n,2,h,w) in [-1,1]."""
+    n, c, hin, win = data.shape
+    _, _, hout, wout = grid.shape
+    x = (grid[:, 0] + 1.0) * (win - 1) / 2.0
+    y = (grid[:, 1] + 1.0) * (hin - 1) / 2.0
+    x0 = jnp.floor(x); y0 = jnp.floor(y)
+    wx = x - x0; wy = y - y0
+    def gather(yy, xx):
+        yy = jnp.clip(yy, 0, hin - 1).astype(jnp.int32)
+        xx = jnp.clip(xx, 0, win - 1).astype(jnp.int32)
+        bidx = jnp.arange(n).reshape((n, 1, 1))
+        return data[bidx, :, yy, xx]  # (n,hout,wout,c)
+    in_bounds = ((x0 >= -1) & (x0 <= win - 1) & (y0 >= -1)
+                 & (y0 <= hin - 1)).astype(data.dtype)[..., None]
+    v00 = gather(y0, x0); v01 = gather(y0, x0 + 1)
+    v10 = gather(y0 + 1, x0); v11 = gather(y0 + 1, x0 + 1)
+    wx_ = wx[..., None]; wy_ = wy[..., None]
+    out = ((1 - wy_) * ((1 - wx_) * v00 + wx_ * v01)
+           + wy_ * ((1 - wx_) * v10 + wx_ * v11)) * in_bounds
+    return jnp.transpose(out, (0, 3, 1, 2))
+
+
+@defop("BilinearSampler")
+def bilinear_sampler(data, grid):
+    """(ref: bilinear_sampler.cc) sample data at grid locations."""
+    return _bilinear_sample(data, grid)
+
+
+@defop("SpatialTransformer")
+def spatial_transformer(data, loc, target_shape=(0, 0),
+                        transform_type="affine", sampler_type="bilinear"):
+    """(ref: spatial_transformer.cc) affine STN."""
+    grid = grid_generator(loc, "affine", target_shape)
+    return _bilinear_sample(data, grid)
+
+
+@defop("Crop", variadic=True)
+def crop_legacy(*args, offset=(0, 0), h_w=(0, 0), num_args=1,
+                center_crop=False):
+    """Legacy Crop op (ref: crop.cc). Crops args[0] to h_w or to
+    args[1]'s spatial size."""
+    data = args[0]
+    if len(args) > 1:
+        th, tw = args[1].shape[2], args[1].shape[3]
+    else:
+        th, tw = int(h_w[0]), int(h_w[1])
+    if center_crop:
+        oy = (data.shape[2] - th) // 2
+        ox = (data.shape[3] - tw) // 2
+    else:
+        oy, ox = int(offset[0]), int(offset[1])
+    return data[:, :, oy:oy + th, ox:ox + tw]

@@ -1,0 +1,52 @@
+"""Ordering ops (ref: src/operator/tensor/ordering_op.cc).  The
+reference used CUB device radix sort; XLA's sort HLO replaces it.
+"""
+import jax
+import jax.numpy as jnp
+
+from .registry import defop
+
+
+@defop("sort")
+def sort(data, axis=-1, is_ascend=True):
+    out = jnp.sort(data, axis=None if axis is None else int(axis))
+    if not is_ascend:
+        out = jnp.flip(out, axis=-1 if axis is None else int(axis))
+    return out
+
+
+@defop("argsort", differentiable=False)
+def argsort(data, axis=-1, is_ascend=True, dtype="float32"):
+    d = data if is_ascend else -data
+    out = jnp.argsort(d, axis=None if axis is None else int(axis),
+                      stable=True)
+    return out.astype(jnp.result_type(data))
+
+
+def _topk_nout(params):
+    rt = params.get("ret_typ", "indices")
+    return 2 if rt == "both" else 1
+
+
+@defop("topk", num_outputs=_topk_nout, differentiable=False)
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False,
+         dtype="float32"):
+    """Top-k along an axis (ref: ordering_op-inl.h TopKParam)."""
+    ax = data.ndim - 1 if axis is None else int(axis) % data.ndim
+    k = int(k)
+    d = jnp.moveaxis(data, ax, -1)
+    vals, idx = jax.lax.top_k(jnp.negative(d) if is_ascend else d, k)
+    if is_ascend:
+        vals = jnp.negative(vals)
+    vals = jnp.moveaxis(vals, -1, ax)
+    idx = jnp.moveaxis(idx, -1, ax).astype(jnp.result_type(data))
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "mask":
+        oh = jnp.sum(jax.nn.one_hot(
+            jnp.moveaxis(idx, ax, -1).astype(jnp.int32), d.shape[-1],
+            dtype=data.dtype), axis=-2)
+        return jnp.moveaxis(oh, -1, ax)
+    if ret_typ == "both":
+        return vals, idx
+    return idx

@@ -1,0 +1,165 @@
+"""Random sampling ops (ref: src/operator/random/sample_op.cc,
+multisample_op.cc, sample_multinomial_op.cc).
+
+The reference threads engine-managed stateful PRNG resources into each
+kernel; the TPU-native design is stateless `jax.random` with threaded
+keys — every op takes an injected ``_rng`` key split from the global
+seed state (see random_state.py), which is what makes sampling
+reproducible *and* jit/pmap-safe.
+"""
+import jax
+import jax.numpy as jnp
+
+from .registry import defop
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(s) for s in shape)
+
+
+def _dt(dtype):
+    from ..base import np_dtype
+    return np_dtype(dtype if dtype not in (None, "None") else "float32")
+
+
+@defop("_random_uniform", aliases=["uniform", "random_uniform"],
+       needs_rng=True, differentiable=False)
+def random_uniform(low=0.0, high=1.0, shape=(), dtype="float32", ctx=None,
+                   _rng=None):
+    return jax.random.uniform(_rng, _shape(shape), _dt(dtype), low, high)
+
+
+@defop("_random_normal", aliases=["normal", "random_normal"],
+       needs_rng=True, differentiable=False)
+def random_normal(loc=0.0, scale=1.0, shape=(), dtype="float32", ctx=None,
+                  _rng=None):
+    return loc + scale * jax.random.normal(_rng, _shape(shape), _dt(dtype))
+
+
+@defop("_random_gamma", aliases=["random_gamma"], needs_rng=True,
+       differentiable=False)
+def random_gamma(alpha=1.0, beta=1.0, shape=(), dtype="float32", ctx=None,
+                 _rng=None):
+    return beta * jax.random.gamma(_rng, alpha, _shape(shape), _dt(dtype))
+
+
+@defop("_random_exponential", aliases=["random_exponential"],
+       needs_rng=True, differentiable=False)
+def random_exponential(lam=1.0, shape=(), dtype="float32", ctx=None,
+                       _rng=None):
+    return jax.random.exponential(_rng, _shape(shape), _dt(dtype)) / lam
+
+
+@defop("_random_poisson", aliases=["random_poisson"], needs_rng=True,
+       differentiable=False)
+def random_poisson(lam=1.0, shape=(), dtype="float32", ctx=None, _rng=None):
+    return jax.random.poisson(_rng, lam, _shape(shape)).astype(_dt(dtype))
+
+
+@defop("_random_negative_binomial", aliases=["random_negative_binomial"],
+       needs_rng=True, differentiable=False)
+def random_negative_binomial(k=1, p=1.0, shape=(), dtype="float32",
+                             ctx=None, _rng=None):
+    k1, k2 = jax.random.split(_rng)
+    lam = jax.random.gamma(k1, float(k), _shape(shape)) * (1 - p) / p
+    return jax.random.poisson(k2, lam, _shape(shape)).astype(_dt(dtype))
+
+
+@defop("_random_generalized_negative_binomial",
+       aliases=["random_generalized_negative_binomial"], needs_rng=True,
+       differentiable=False)
+def random_gen_neg_binomial(mu=1.0, alpha=1.0, shape=(), dtype="float32",
+                            ctx=None, _rng=None):
+    k1, k2 = jax.random.split(_rng)
+    r = 1.0 / alpha
+    p = r / (r + mu)
+    lam = jax.random.gamma(k1, r, _shape(shape)) * (1 - p) / p
+    return jax.random.poisson(k2, lam, _shape(shape)).astype(_dt(dtype))
+
+
+# tensor-parameter multisample variants (ref: multisample_op.cc)
+@defop("_sample_uniform", needs_rng=True, differentiable=False)
+def sample_uniform(low, high, shape=(), dtype="float32", _rng=None):
+    s = low.shape + _shape(shape)
+    u = jax.random.uniform(_rng, s, _dt(dtype))
+    return (low.reshape(low.shape + (1,) * len(_shape(shape)))
+            + u * (high - low).reshape(
+                low.shape + (1,) * len(_shape(shape))))
+
+
+@defop("_sample_normal", needs_rng=True, differentiable=False)
+def sample_normal(mu, sigma, shape=(), dtype="float32", _rng=None):
+    s = mu.shape + _shape(shape)
+    ext = (1,) * len(_shape(shape))
+    z = jax.random.normal(_rng, s, _dt(dtype))
+    return mu.reshape(mu.shape + ext) + z * sigma.reshape(sigma.shape + ext)
+
+
+@defop("_sample_gamma", needs_rng=True, differentiable=False)
+def sample_gamma(alpha, beta, shape=(), dtype="float32", _rng=None):
+    s = alpha.shape + _shape(shape)
+    ext = (1,) * len(_shape(shape))
+    g = jax.random.gamma(_rng, alpha.reshape(alpha.shape + ext), s,
+                         _dt(dtype))
+    return g * beta.reshape(beta.shape + ext)
+
+
+@defop("_sample_exponential", needs_rng=True, differentiable=False)
+def sample_exponential(lam, shape=(), dtype="float32", _rng=None):
+    s = lam.shape + _shape(shape)
+    ext = (1,) * len(_shape(shape))
+    return (jax.random.exponential(_rng, s, _dt(dtype))
+            / lam.reshape(lam.shape + ext))
+
+
+@defop("_sample_poisson", needs_rng=True, differentiable=False)
+def sample_poisson(lam, shape=(), dtype="float32", _rng=None):
+    s = lam.shape + _shape(shape)
+    ext = (1,) * len(_shape(shape))
+    return jax.random.poisson(
+        _rng, lam.reshape(lam.shape + ext), s).astype(_dt(dtype))
+
+
+@defop("_sample_multinomial", aliases=["sample_multinomial"],
+       needs_rng=True, differentiable=False,
+       num_outputs=lambda p: 2 if p.get("get_prob") else 1)
+def sample_multinomial(data, shape=(), get_prob=False, dtype="int32",
+                       _rng=None):
+    """Draw class indices from probability rows (ref:
+    sample_multinomial_op.cc)."""
+    n = _shape(shape)
+    count = 1
+    for s in n:
+        count *= s
+    logits = jnp.log(jnp.maximum(data, 1e-30))
+    idx = jax.random.categorical(
+        _rng, logits[..., None, :].repeat(max(count, 1), axis=-2), axis=-1)
+    out_shape = data.shape[:-1] + n
+    idx = idx.reshape(out_shape).astype(_dt(dtype))
+    if get_prob:
+        logp = jnp.take_along_axis(
+            jax.nn.log_softmax(logits, axis=-1)
+            .reshape(data.shape[:-1] + (1,) * max(len(n), 1)
+                     + (data.shape[-1],)).astype(jnp.float32),
+            idx[..., None].astype(jnp.int32), axis=-1).squeeze(-1)
+        return idx, logp
+    return idx
+
+
+@defop("_shuffle", aliases=["shuffle"], needs_rng=True,
+       differentiable=False)
+def shuffle(data, _rng=None):
+    return jax.random.permutation(_rng, data, axis=0)
+
+
+@defop("_random_randint", needs_rng=True, differentiable=False)
+def random_randint(low=0, high=1, shape=(), dtype="int32", ctx=None,
+                   _rng=None):
+    """Uniform integers in [low, high) via jax.random.randint (exact
+    endpoint distribution; no float truncation bias)."""
+    return jax.random.randint(_rng, _shape(shape), int(low), int(high)
+                              ).astype(_dt(dtype))

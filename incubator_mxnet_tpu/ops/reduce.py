@@ -1,0 +1,96 @@
+"""Reduction and broadcasting ops (ref:
+src/operator/tensor/broadcast_reduce_op_value.cc / _index.cc).
+"""
+import jax.numpy as jnp
+
+from .registry import defop, alias
+
+
+def _norm_axis(axis, ndim, exclude=False):
+    if axis is None or axis == ():
+        ax = tuple(range(ndim))
+    elif isinstance(axis, int):
+        ax = (axis % ndim,)
+    else:
+        ax = tuple(a % ndim for a in axis)
+    if exclude:
+        ax = tuple(i for i in range(ndim) if i not in ax)
+    return ax
+
+
+def _make_reduce(name, f):
+    def _op(data, axis=None, keepdims=False, exclude=False, _f=f):
+        ax = _norm_axis(axis, data.ndim, exclude)
+        return _f(data, axis=ax, keepdims=bool(keepdims))
+    _op.__name__ = name
+    _op.__doc__ = f"Reduce-{name} over axes."
+    return _op
+
+
+for _n, _f in {"sum": jnp.sum, "mean": jnp.mean, "prod": jnp.prod,
+               "nansum": jnp.nansum, "nanprod": jnp.nanprod,
+               "max": jnp.max, "min": jnp.min}.items():
+    defop(_n)(_make_reduce(_n, _f))
+
+alias("sum", "sum_axis")
+alias("max", "max_axis")
+alias("min", "min_axis")
+
+
+@defop("norm")
+def norm(data, ord=2, axis=None, keepdims=False):
+    """L2 (or L1) norm (ref: broadcast_reduce_op_value.cc norm)."""
+    ax = None if axis is None else _norm_axis(axis, data.ndim)
+    if ord == 1:
+        return jnp.sum(jnp.abs(data), axis=ax, keepdims=bool(keepdims))
+    return jnp.sqrt(jnp.sum(jnp.square(data), axis=ax,
+                            keepdims=bool(keepdims)))
+
+
+def _make_arg(name, f):
+    def _op(data, axis=None, keepdims=False, _f=f):
+        if axis is None:
+            out = _f(data.reshape(-1), axis=0)
+            if keepdims:
+                out = out.reshape((1,) * data.ndim)
+        else:
+            out = _f(data, axis=int(axis))
+            if keepdims:
+                out = jnp.expand_dims(out, int(axis))
+        return out.astype(jnp.result_type(data))
+    _op.__name__ = name
+    return _op
+
+
+defop("argmax", differentiable=False)(_make_arg("argmax", jnp.argmax))
+defop("argmin", differentiable=False)(_make_arg("argmin", jnp.argmin))
+
+
+@defop("argmax_channel", differentiable=False)
+def argmax_channel(data):
+    """argmax over axis 1 (ref: broadcast_reduce_op_index.cc)."""
+    return jnp.argmax(data, axis=1).astype(jnp.result_type(data))
+
+
+@defop("broadcast_axis", aliases=["broadcast_axes"])
+def broadcast_axis(data, axis=(), size=()):
+    """Broadcast size-1 axes to given sizes."""
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    sizes = (size,) if isinstance(size, int) else tuple(size)
+    shape = list(data.shape)
+    for a, s in zip(axes, sizes):
+        shape[a % data.ndim] = s
+    return jnp.broadcast_to(data, tuple(shape))
+
+
+@defop("broadcast_to")
+def broadcast_to(data, shape=()):
+    """Broadcast to an explicit shape; 0 keeps the input dim."""
+    tgt = tuple(int(data.shape[i]) if s == 0 else int(s)
+                for i, s in enumerate(shape))
+    return jnp.broadcast_to(data, tgt)
+
+
+@defop("broadcast_like")
+def broadcast_like(lhs, rhs):
+    return jnp.broadcast_to(lhs, rhs.shape)

@@ -1,0 +1,11 @@
+"""mx.random: seeding + module-level sampling helpers
+(ref: python/mxnet/random.py)."""
+from .random_state import seed  # noqa: F401
+from .ndarray.random import (uniform, normal, gamma, exponential, poisson,  # noqa: F401
+                             negative_binomial,
+                             generalized_negative_binomial, multinomial,
+                             shuffle, randint)
+
+__all__ = ["seed", "uniform", "normal", "gamma", "exponential", "poisson",
+           "negative_binomial", "generalized_negative_binomial",
+           "multinomial", "shuffle", "randint"]

@@ -1,0 +1,62 @@
+"""Global PRNG state: a stateful facade over stateless jax.random keys.
+
+The reference manages per-device PRNG states as engine resources
+(ref: include/mxnet/resource.h kRandom, src/resource.cc).  The
+TPU-native design is stateless threaded keys: a global root key that
+`seed()` resets, split once per sampling op.  Traced contexts
+(hybridized blocks, compiled executors) push a *provider* that splits
+from a key passed in as a function argument, so random ops stay
+jit-pure and reproducible.
+"""
+import threading
+
+import jax
+
+_state = threading.local()
+
+
+def _root():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.PRNGKey(0)
+        _state.providers = []
+    return _state
+
+
+def seed(seed_state):
+    """Seed the global generator (analog of mx.random.seed)."""
+    s = _root()
+    s.key = jax.random.PRNGKey(int(seed_state))
+
+
+def next_key():
+    """Next fresh key: from the innermost provider if one is active
+    (traced contexts), else by splitting the global root key."""
+    s = _root()
+    if s.providers:
+        return s.providers[-1]()
+    s.key, sub = jax.random.split(s.key)
+    return sub
+
+
+class key_provider:
+    """Context manager installing a key source for traced regions.
+
+    ``base_key`` is split deterministically per draw, so a traced
+    function that takes a key argument stays a pure function of it.
+    """
+
+    def __init__(self, base_key):
+        self.base_key = base_key
+        self.count = 0
+
+    def _next(self):
+        k = jax.random.fold_in(self.base_key, self.count)
+        self.count += 1
+        return k
+
+    def __enter__(self):
+        _root().providers.append(self._next)
+        return self
+
+    def __exit__(self, *exc):
+        _root().providers.pop()

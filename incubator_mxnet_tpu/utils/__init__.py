@@ -1,0 +1,13 @@
+"""Base utilities: env-flag registry, logging, generic class registry.
+
+TPU-native replacement for the roles dmlc-core plays in the reference
+(ref: dmlc::GetEnv use sites, dmlc LOG/CHECK, python/mxnet/registry.py).
+"""
+from .env import EnvFlag, get_env, register_env, list_env
+from .registry import Registry, get_registry
+from .log import get_logger
+
+__all__ = [
+    "EnvFlag", "get_env", "register_env", "list_env",
+    "Registry", "get_registry", "get_logger",
+]

@@ -1,0 +1,73 @@
+"""Runtime environment-flag registry.
+
+The reference reads ~29 documented env vars ad hoc via ``dmlc::GetEnv``
+(ref: docs/faq/env_var.md).  Here flags are declared once in a central
+registry so ``list_env()`` is always complete and typos fail loudly.
+
+Flags use the ``MXTPU_`` prefix; the reference's ``MXNET_`` prefix is
+accepted as a fallback for familiarity.
+"""
+import os
+
+_REGISTRY = {}
+
+
+class EnvFlag:
+    """A declared environment flag with type, default and docstring."""
+
+    __slots__ = ("name", "type", "default", "help")
+
+    def __init__(self, name, type_, default, help_=""):
+        self.name = name
+        self.type = type_
+        self.default = default
+        self.help = help_
+
+    def get(self):
+        raw = os.environ.get(self.name)
+        if raw is None and self.name.startswith("MXTPU_"):
+            raw = os.environ.get("MXNET_" + self.name[len("MXTPU_"):])
+        if raw is None:
+            return self.default
+        if self.type is bool:
+            return raw not in ("0", "false", "False", "")
+        try:
+            return self.type(raw)
+        except ValueError:
+            return self.default
+
+
+def register_env(name, type_, default, help_=""):
+    flag = EnvFlag(name, type_, default, help_)
+    _REGISTRY[name] = flag
+    return flag
+
+
+def get_env(name):
+    """Read a registered env flag (raises KeyError on unregistered names)."""
+    return _REGISTRY[name].get()
+
+
+def list_env():
+    """All registered flags, for docs/diagnose output."""
+    return dict(_REGISTRY)
+
+
+# Core runtime flags (analogs of the reference's engine/exec/kvstore vars).
+register_env("MXTPU_ENGINE_TYPE", str, "async",
+             "'async' (default, XLA async dispatch) or 'naive' "
+             "(block after every op; analog of MXNET_ENGINE_TYPE=NaiveEngine)")
+register_env("MXTPU_EXEC_BULK_EXEC_TRAIN", bool, True,
+             "fuse forward+backward into one compiled executable")
+register_env("MXTPU_DEFAULT_DTYPE", str, "float32",
+             "default dtype for new arrays")
+register_env("MXTPU_ENABLE_X64", bool, False, "enable float64/int64 support")
+register_env("MXTPU_PROFILER_AUTOSTART", bool, False,
+             "start the profiler at import time")
+register_env("MXTPU_PROFILER_DIR", str, "profile_output",
+             "directory for profiler trace dumps")
+register_env("MXTPU_KVSTORE_BIGARRAY_BOUND", int, 1000000,
+             "size threshold for chunked kvstore reductions")
+register_env("MXTPU_CPU_WORKER_NTHREADS", int, 4,
+             "host worker threads for data pipeline")
+register_env("MXTPU_SEED", int, 0, "global RNG seed at import")

@@ -1,0 +1,20 @@
+"""Test configuration: force an 8-device virtual CPU platform so
+multi-device/sharding paths are exercised without TPU hardware
+(analog of the reference testing model parallelism on cpu(0)/cpu(1),
+ref: tests/python/unittest/test_multi_device_exec.py).
+
+Must run before the jax backend is initialized (it is lazy, so doing
+this at conftest import time is early enough).
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,145 @@
+"""Autograd tests (ref: tests/python/unittest/test_autograd.py)."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, autograd
+
+
+def test_simple_backward():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy())
+
+
+def test_chain_and_broadcast():
+    x = nd.array(np.random.RandomState(0).rand(3, 4).astype("float32"))
+    w = nd.array(np.random.RandomState(1).rand(4, 2).astype("float32"))
+    x.attach_grad()
+    w.attach_grad()
+    with autograd.record():
+        y = nd.dot(x, w)
+        z = nd.relu(y - 0.5).sum()
+    z.backward()
+    mask = (np.dot(x.asnumpy(), w.asnumpy()) - 0.5 > 0).astype("float32")
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               np.dot(mask, w.asnumpy().T), rtol=1e-5)
+    np.testing.assert_allclose(w.grad.asnumpy(),
+                               np.dot(x.asnumpy().T, mask), rtol=1e-5)
+
+
+def test_grad_req_add():
+    x = nd.array([1.0, 2.0])
+    grad = nd.zeros((2,))
+    autograd.mark_variables([x], [grad], "add")
+    for _ in range(3):
+        with autograd.record():
+            y = (x * x).sum()
+        y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 3 * 2 * x.asnumpy())
+
+
+def test_record_pause():
+    x = nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+        y = x * 2
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2.0])
+
+
+def test_train_predict_mode():
+    with autograd.record(train_mode=False):
+        assert not autograd.is_training()
+        with autograd.train_mode():
+            assert autograd.is_training()
+    with autograd.predict_mode():
+        assert not autograd.is_training()
+
+
+def test_head_gradient():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 4
+    y.backward(nd.array([1.0, 0.5, 0.25]))
+    np.testing.assert_allclose(x.grad.asnumpy(), [4.0, 2.0, 1.0])
+
+
+def test_autograd_grad_api():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+    (g,) = autograd.grad(y, [x])
+    np.testing.assert_allclose(g.asnumpy(), [12.0])
+
+
+def test_multi_output_op_backward():
+    x = nd.array(np.arange(8, dtype="float32").reshape(2, 4))
+    x.attach_grad()
+    with autograd.record():
+        a, b = nd.split(x, num_outputs=2, axis=1)
+        y = (a * 2 + b * 3).sum()
+    y.backward()
+    expect = np.concatenate([2 * np.ones((2, 2)), 3 * np.ones((2, 2))], 1)
+    np.testing.assert_allclose(x.grad.asnumpy(), expect)
+
+
+def test_detach_and_stop_gradient():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        z = nd.BlockGrad(y) + x
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [1.0])
+
+
+def test_dropout_modes():
+    x = nd.ones((100, 100))
+    with autograd.record(train_mode=True):
+        y = nd.Dropout(x, p=0.5)
+    frac = (y.asnumpy() == 0).mean()
+    assert 0.3 < frac < 0.7
+    with autograd.predict_mode():
+        y = nd.Dropout(x, p=0.5)
+    assert (y.asnumpy() == 1).all()
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = nd.sigmoid(x)
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self.saved_tensors
+            return dy * y * (1 - y)
+
+    x = nd.array([0.5, -1.0])
+    x.attach_grad()
+    f = Sigmoid()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    s = 1 / (1 + np.exp(-x.asnumpy()))
+    np.testing.assert_allclose(x.grad.asnumpy(), s * (1 - s), rtol=1e-5)
+
+
+def test_second_order_unsupported_path():
+    # higher-order via composition still works through jax directly;
+    # here we just assert grad() with create_graph=False returns values
+    x = nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    (g,) = autograd.grad(y, [x], retain_graph=True)
+    np.testing.assert_allclose(g.asnumpy(), [2.0])

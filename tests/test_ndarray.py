@@ -1,0 +1,151 @@
+"""NDArray tests (ref: tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+
+
+def test_creation():
+    x = nd.zeros((2, 3))
+    assert x.shape == (2, 3)
+    assert x.dtype == np.float32
+    assert (x.asnumpy() == 0).all()
+    y = nd.ones((4,), dtype="int32")
+    assert y.dtype == np.int32
+    z = nd.full((2, 2), 7.5)
+    assert (z.asnumpy() == 7.5).all()
+    a = nd.arange(0, 10, 2)
+    np.testing.assert_allclose(a.asnumpy(), np.arange(0, 10, 2,
+                                                      "float32"))
+
+
+def test_arithmetic_and_scalars():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = nd.array([[10.0, 20.0], [30.0, 40.0]])
+    np.testing.assert_allclose((a + b).asnumpy(), a.asnumpy() + b.asnumpy())
+    np.testing.assert_allclose((a - b).asnumpy(), a.asnumpy() - b.asnumpy())
+    np.testing.assert_allclose((a * b).asnumpy(), a.asnumpy() * b.asnumpy())
+    np.testing.assert_allclose((b / a).asnumpy(), b.asnumpy() / a.asnumpy())
+    np.testing.assert_allclose((a + 1).asnumpy(), a.asnumpy() + 1)
+    np.testing.assert_allclose((2 - a).asnumpy(), 2 - a.asnumpy())
+    np.testing.assert_allclose((3 / a).asnumpy(), 3 / a.asnumpy())
+    np.testing.assert_allclose((a ** 2).asnumpy(), a.asnumpy() ** 2)
+    np.testing.assert_allclose((-a).asnumpy(), -a.asnumpy())
+    np.testing.assert_allclose((a > 2).asnumpy(),
+                               (a.asnumpy() > 2).astype("float32"))
+
+
+def test_inplace():
+    a = nd.ones((2, 2))
+    a += 1
+    np.testing.assert_allclose(a.asnumpy(), 2 * np.ones((2, 2)))
+    a *= 3
+    np.testing.assert_allclose(a.asnumpy(), 6 * np.ones((2, 2)))
+    a[:] = 5
+    np.testing.assert_allclose(a.asnumpy(), 5 * np.ones((2, 2)))
+
+
+def test_indexing():
+    a = nd.array(np.arange(24).reshape(2, 3, 4))
+    np.testing.assert_allclose(a[1].asnumpy(),
+                               np.arange(24).reshape(2, 3, 4)[1])
+    np.testing.assert_allclose(a[0, 1:3].asnumpy(),
+                               np.arange(24).reshape(2, 3, 4)[0, 1:3])
+    a[0] = 0
+    assert (a.asnumpy()[0] == 0).all()
+    a[1, 2, 3] = -1
+    assert a.asnumpy()[1, 2, 3] == -1
+
+
+def test_reshape_special_codes():
+    a = nd.zeros((2, 3, 4))
+    assert a.reshape((6, 4)).shape == (6, 4)
+    assert a.reshape((-1,)).shape == (24,)
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert a.reshape((-2,)).shape == (2, 3, 4)
+    assert a.reshape((-3, 4)).shape == (6, 4)
+    assert a.reshape((-4, 1, 2, 3, 4)).shape == (1, 2, 3, 4)
+
+
+def test_shape_methods():
+    a = nd.array(np.arange(12).reshape(3, 4))
+    assert a.T.shape == (4, 3)
+    assert a.flatten().shape == (3, 4)
+    assert a.expand_dims(0).shape == (1, 3, 4)
+    assert a.transpose((1, 0)).shape == (4, 3)
+    assert nd.concatenate([a, a], axis=0).shape == (6, 4)
+    parts = a.split(2, axis=1)
+    assert parts[0].shape == (3, 2)
+
+
+def test_reductions():
+    x = np.random.RandomState(0).rand(3, 4, 5).astype("float32")
+    a = nd.array(x)
+    np.testing.assert_allclose(a.sum().asnumpy(), x.sum(), rtol=1e-5)
+    np.testing.assert_allclose(a.mean(axis=1).asnumpy(), x.mean(1),
+                               rtol=1e-5)
+    np.testing.assert_allclose(a.max(axis=(0, 2)).asnumpy(),
+                               x.max((0, 2)), rtol=1e-5)
+    np.testing.assert_allclose(
+        nd.sum(a, axis=1, exclude=True).asnumpy(), x.sum((0, 2)),
+        rtol=1e-4)
+
+
+def test_dtype_cast_copy():
+    a = nd.ones((2, 2))
+    b = a.astype("int32")
+    assert b.dtype == np.int32
+    c = a.copy()
+    c[:] = 9
+    assert (a.asnumpy() == 1).all()
+    d = nd.zeros((2, 2))
+    a.copyto(d)
+    assert (d.asnumpy() == 1).all()
+
+
+def test_context_placement():
+    ctx = mx.cpu(1)
+    a = nd.ones((2, 2), ctx=ctx)
+    assert a.context == mx.cpu(1)
+    b = a.as_in_context(mx.cpu(0))
+    assert b.context == mx.cpu(0)
+    # cross-device add after explicit transfer
+    c = b + a.as_in_context(mx.cpu(0))
+    assert (c.asnumpy() == 2).all()
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "arrays.bin")
+    d = {"w": nd.ones((2, 2)), "b": nd.zeros((3,))}
+    nd.save(fname, d)
+    loaded = nd.load(fname)
+    assert set(loaded) == {"w", "b"}
+    np.testing.assert_allclose(loaded["w"].asnumpy(), np.ones((2, 2)))
+    lst = [nd.ones((1,)), nd.zeros((2,))]
+    nd.save(fname, lst)
+    loaded = nd.load(fname)
+    assert isinstance(loaded, list) and len(loaded) == 2
+
+
+def test_wait_and_iter():
+    a = nd.ones((4, 2))
+    a.wait_to_read()
+    nd.waitall()
+    rows = list(a)
+    assert len(rows) == 4 and rows[0].shape == (2,)
+    assert len(a) == 4
+
+
+def test_sparse_roundtrip():
+    dense = np.array([[0, 1, 0], [2, 0, 3]], dtype="float32")
+    csr = nd.CSRNDArray.__new__  # placeholder to ensure class exists
+    from incubator_mxnet_tpu.ndarray import sparse
+    c = sparse.csr_matrix(dense)
+    np.testing.assert_allclose(c.asnumpy(), dense)
+    assert c.stype == "csr"
+    r = sparse.row_sparse_array(dense)
+    np.testing.assert_allclose(r.asnumpy(), dense)
+    assert r.stype == "row_sparse"
+    back = c.tostype("default")
+    assert back.stype == "default"

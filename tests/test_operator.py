@@ -1,0 +1,428 @@
+"""Per-op numeric checks vs numpy oracle
+(ref: tests/python/unittest/test_operator.py — the main correctness
+net; numpy is the oracle for CPU, interpreter for compiled TPU)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, autograd
+
+RS = np.random.RandomState(42)
+
+
+def _a(*shape):
+    return RS.rand(*shape).astype("float32") + 0.1
+
+
+def test_unary_math_ops():
+    x = _a(3, 4)
+    cases = {
+        "exp": np.exp, "log": np.log, "sqrt": np.sqrt,
+        "square": np.square, "abs": np.abs, "sign": np.sign,
+        "floor": np.floor, "ceil": np.ceil, "round": np.round,
+        "sin": np.sin, "cos": np.cos, "tanh": np.tanh,
+        "log1p": np.log1p, "expm1": np.expm1,
+        "rsqrt": lambda v: 1 / np.sqrt(v),
+        "reciprocal": lambda v: 1 / v,
+        "cbrt": np.cbrt,
+    }
+    for name, ref in cases.items():
+        out = getattr(nd, name)(nd.array(x)).asnumpy()
+        np.testing.assert_allclose(out, ref(x), rtol=1e-4, atol=1e-5,
+                                   err_msg=name)
+
+
+def test_activation_types():
+    x = _a(2, 5) - 0.5
+    np.testing.assert_allclose(
+        nd.Activation(nd.array(x), act_type="relu").asnumpy(),
+        np.maximum(x, 0), rtol=1e-5)
+    np.testing.assert_allclose(
+        nd.Activation(nd.array(x), act_type="sigmoid").asnumpy(),
+        1 / (1 + np.exp(-x)), rtol=1e-5)
+    np.testing.assert_allclose(
+        nd.LeakyReLU(nd.array(x), act_type="leaky", slope=0.1).asnumpy(),
+        np.where(x > 0, x, 0.1 * x), rtol=1e-5)
+    np.testing.assert_allclose(
+        nd.LeakyReLU(nd.array(x), act_type="elu", slope=0.3).asnumpy(),
+        np.where(x > 0, x, 0.3 * np.expm1(x)), rtol=1e-5)
+
+
+def test_fully_connected():
+    x, w, b = _a(4, 6), _a(3, 6), _a(3)
+    out = nd.FullyConnected(nd.array(x), nd.array(w), nd.array(b),
+                            num_hidden=3).asnumpy()
+    np.testing.assert_allclose(out, x @ w.T + b, rtol=1e-4)
+    out2 = nd.FullyConnected(nd.array(x), nd.array(w), no_bias=True,
+                             num_hidden=3).asnumpy()
+    np.testing.assert_allclose(out2, x @ w.T, rtol=1e-4)
+
+
+def test_convolution_shapes_and_values():
+    x = _a(2, 3, 8, 8)
+    w = _a(4, 3, 3, 3)
+    b = _a(4)
+    out = nd.Convolution(nd.array(x), nd.array(w), nd.array(b),
+                         kernel=(3, 3), num_filter=4)
+    assert out.shape == (2, 4, 6, 6)
+    out_s = nd.Convolution(nd.array(x), nd.array(w), nd.array(b),
+                           kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                           num_filter=4)
+    assert out_s.shape == (2, 4, 4, 4)
+    # value check against naive loop at one output position
+    ref00 = (x[0, :, 0:3, 0:3] * w[1]).sum() + b[1]
+    np.testing.assert_allclose(out.asnumpy()[0, 1, 0, 0], ref00,
+                               rtol=1e-4)
+
+
+def test_grouped_and_1d_conv():
+    x = _a(2, 4, 10)
+    w = _a(6, 2, 3)
+    out = nd.Convolution(nd.array(x), nd.array(w), no_bias=True,
+                         kernel=(3,), num_filter=6, num_group=2)
+    assert out.shape == (2, 6, 8)
+
+
+def test_deconvolution_shape():
+    x = _a(1, 3, 4, 4)
+    w = _a(3, 2, 3, 3)  # (in, out, kh, kw)
+    out = nd.Deconvolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                           stride=(2, 2), num_filter=2)
+    assert out.shape == (1, 2, 9, 9)
+    # identity check: stride 1, kernel 1
+    w1 = np.ones((1, 1, 1, 1), "float32")
+    x1 = _a(1, 1, 3, 3)
+    out1 = nd.Deconvolution(nd.array(x1), nd.array(w1), kernel=(1, 1),
+                            num_filter=1)
+    np.testing.assert_allclose(out1.asnumpy(), x1, rtol=1e-5)
+
+
+def test_pooling():
+    x = _a(1, 2, 4, 4)
+    mp = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2),
+                    pool_type="max")
+    assert mp.shape == (1, 2, 2, 2)
+    np.testing.assert_allclose(mp.asnumpy()[0, 0, 0, 0],
+                               x[0, 0, :2, :2].max(), rtol=1e-6)
+    ap = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2),
+                    pool_type="avg")
+    np.testing.assert_allclose(ap.asnumpy()[0, 1, 1, 1],
+                               x[0, 1, 2:, 2:].mean(), rtol=1e-6)
+    gp = nd.Pooling(nd.array(x), global_pool=True, pool_type="max")
+    assert gp.shape == (1, 2, 1, 1)
+
+
+def test_batchnorm_train_and_inference():
+    x = _a(4, 3, 2, 2)
+    gamma, beta = np.ones(3, "float32"), np.zeros(3, "float32")
+    mean = np.zeros(3, "float32")
+    var = np.ones(3, "float32")
+    g, b = nd.array(gamma), nd.array(beta)
+    mm, mv = nd.array(mean), nd.array(var)
+    # training mode normalizes by batch stats and updates aux in place
+    with autograd.train_mode():
+        out = nd.BatchNorm(nd.array(x), g, b, mm, mv, fix_gamma=False,
+                           momentum=0.9)
+    bm = x.mean(axis=(0, 2, 3))
+    np.testing.assert_allclose(out.asnumpy().mean(axis=(0, 2, 3)),
+                               np.zeros(3), atol=1e-5)
+    np.testing.assert_allclose(mm.asnumpy(), 0.9 * mean + 0.1 * bm,
+                               rtol=1e-4)
+    # inference mode uses moving stats
+    out_inf = nd.BatchNorm(nd.array(x), g, b, nd.array(mean),
+                           nd.array(var), fix_gamma=False)
+    np.testing.assert_allclose(out_inf.asnumpy(),
+                               (x - 0) / np.sqrt(1 + 1e-3), rtol=1e-3)
+
+
+def test_softmax_family():
+    x = _a(3, 5)
+    sm = nd.softmax(nd.array(x)).asnumpy()
+    e = np.exp(x - x.max(-1, keepdims=True))
+    np.testing.assert_allclose(sm, e / e.sum(-1, keepdims=True), rtol=1e-5)
+    lsm = nd.log_softmax(nd.array(x)).asnumpy()
+    np.testing.assert_allclose(lsm, np.log(sm), rtol=1e-4, atol=1e-5)
+
+
+def test_softmax_output_gradient():
+    x = _a(4, 3)
+    label = np.array([0, 2, 1, 1], "float32")
+    data = nd.array(x)
+    data.attach_grad()
+    with autograd.record():
+        out = nd.SoftmaxOutput(data, nd.array(label))
+    out.backward()
+    sm = np.exp(x - x.max(-1, keepdims=True))
+    sm /= sm.sum(-1, keepdims=True)
+    onehot = np.eye(3, dtype="float32")[label.astype(int)]
+    np.testing.assert_allclose(data.grad.asnumpy(), sm - onehot,
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_regression_outputs():
+    x = _a(4, 2)
+    lbl = _a(4, 2)
+    data = nd.array(x)
+    data.attach_grad()
+    with autograd.record():
+        out = nd.LinearRegressionOutput(data, nd.array(lbl))
+    np.testing.assert_allclose(out.asnumpy(), x, rtol=1e-6)
+    out.backward()
+    np.testing.assert_allclose(data.grad.asnumpy(), (x - lbl) / 2,
+                               rtol=1e-5)
+
+
+def test_matrix_ops():
+    x = _a(2, 3, 4)
+    np.testing.assert_allclose(nd.transpose(nd.array(x)).asnumpy(),
+                               x.transpose())
+    np.testing.assert_allclose(
+        nd.slice(nd.array(x), begin=(0, 1), end=(2, 3)).asnumpy(),
+        x[0:2, 1:3])
+    np.testing.assert_allclose(
+        nd.slice_axis(nd.array(x), axis=2, begin=1, end=3).asnumpy(),
+        x[:, :, 1:3])
+    np.testing.assert_allclose(nd.clip(nd.array(x), a_min=0.2,
+                                       a_max=0.8).asnumpy(),
+                               np.clip(x, 0.2, 0.8))
+    np.testing.assert_allclose(nd.tile(nd.array(x), reps=(2, 1, 1)
+                                       ).asnumpy(), np.tile(x, (2, 1, 1)))
+    np.testing.assert_allclose(nd.reverse(nd.array(x), axis=1).asnumpy(),
+                               x[:, ::-1, :])
+    np.testing.assert_allclose(
+        nd.Pad(nd.array(x[:, :, :, None] if x.ndim == 3 else x),
+               mode="constant",
+               pad_width=(0, 0, 0, 0, 1, 1, 0, 0)).asnumpy(),
+        np.pad(x[:, :, :, None], ((0, 0), (0, 0), (1, 1), (0, 0))))
+
+
+def test_dot_and_batch_dot():
+    a, b = _a(3, 4), _a(4, 5)
+    np.testing.assert_allclose(nd.dot(nd.array(a), nd.array(b)).asnumpy(),
+                               a @ b, rtol=1e-4)
+    np.testing.assert_allclose(
+        nd.dot(nd.array(a), nd.array(b.T), transpose_b=True).asnumpy(),
+        a @ b, rtol=1e-4)
+    ba, bb = _a(2, 3, 4), _a(2, 4, 5)
+    np.testing.assert_allclose(
+        nd.batch_dot(nd.array(ba), nd.array(bb)).asnumpy(),
+        np.matmul(ba, bb), rtol=1e-4)
+
+
+def test_indexing_ops():
+    w = _a(10, 4)
+    idx = np.array([1, 3, 5], "float32")
+    np.testing.assert_allclose(
+        nd.Embedding(nd.array(idx), nd.array(w), input_dim=10,
+                     output_dim=4).asnumpy(), w[idx.astype(int)])
+    np.testing.assert_allclose(
+        nd.take(nd.array(w), nd.array(idx)).asnumpy(), w[idx.astype(int)])
+    oh = nd.one_hot(nd.array(idx), depth=10).asnumpy()
+    assert oh.shape == (3, 10) and oh[0, 1] == 1 and oh.sum() == 3
+    data = _a(3, 5)
+    pk = nd.pick(nd.array(data), nd.array(np.array([0, 2, 4], "float32")),
+                 axis=1).asnumpy()
+    np.testing.assert_allclose(pk, data[np.arange(3), [0, 2, 4]])
+
+
+def test_ordering_ops():
+    x = _a(3, 6)
+    np.testing.assert_allclose(nd.sort(nd.array(x)).asnumpy(),
+                               np.sort(x, -1))
+    np.testing.assert_allclose(nd.argsort(nd.array(x)).asnumpy(),
+                               np.argsort(x, -1, kind="stable"))
+    v, i = nd.topk(nd.array(x), k=2, ret_typ="both")
+    np.testing.assert_allclose(v.asnumpy(), np.sort(x, -1)[:, ::-1][:, :2],
+                               rtol=1e-6)
+
+
+def test_sequence_ops():
+    x = _a(4, 3, 2)  # (T, B, F)
+    lengths = np.array([2, 4, 1], "float32")
+    masked = nd.SequenceMask(nd.array(x), nd.array(lengths),
+                             use_sequence_length=True).asnumpy()
+    assert (masked[2:, 0] == 0).all() and (masked[1:, 2] == 0).all()
+    assert (masked[:2, 0] == x[:2, 0]).all()
+    last = nd.SequenceLast(nd.array(x), nd.array(lengths),
+                           use_sequence_length=True).asnumpy()
+    np.testing.assert_allclose(last[0], x[1, 0])
+    np.testing.assert_allclose(last[1], x[3, 1])
+    rev = nd.SequenceReverse(nd.array(x), nd.array(lengths),
+                             use_sequence_length=True).asnumpy()
+    np.testing.assert_allclose(rev[0, 0], x[1, 0])
+    np.testing.assert_allclose(rev[1, 0], x[0, 0])
+    np.testing.assert_allclose(rev[2, 0], x[2, 0])
+
+
+def test_norm_ops():
+    x = _a(2, 3, 4, 4)
+    g = np.ones(3, "float32")
+    b = np.zeros(3, "float32")
+    out = nd.InstanceNorm(nd.array(x), nd.array(g), nd.array(b))
+    np.testing.assert_allclose(out.asnumpy().mean(axis=(2, 3)),
+                               np.zeros((2, 3)), atol=1e-4)
+    l2 = nd.L2Normalization(nd.array(x)).asnumpy()
+    norms = np.sqrt((l2.reshape(2, -1) ** 2).sum(1))
+    np.testing.assert_allclose(norms, np.ones(2), rtol=1e-4)
+    ln = nd.LayerNorm(nd.array(x), nd.array(np.ones(4, "float32")),
+                      nd.array(np.zeros(4, "float32")))
+    np.testing.assert_allclose(ln.asnumpy().mean(-1),
+                               np.zeros((2, 3, 4)), atol=1e-4)
+    lr = nd.LRN(nd.array(x), nsize=3)
+    assert lr.shape == x.shape
+
+
+def test_linalg_ops():
+    a = _a(3, 3)
+    spd = a @ a.T + 3 * np.eye(3, dtype="float32")
+    L = nd.linalg.potrf(nd.array(spd)).asnumpy()
+    np.testing.assert_allclose(L @ L.T, spd, rtol=1e-4)
+    np.testing.assert_allclose(
+        nd.linalg.gemm2(nd.array(a), nd.array(a), transpose_b=True
+                        ).asnumpy(), a @ a.T, rtol=1e-4)
+    sld = nd.linalg.sumlogdiag(nd.array(spd)).asnumpy()
+    np.testing.assert_allclose(sld, np.log(np.diag(spd)).sum(), rtol=1e-5)
+
+
+def test_random_ops_statistics():
+    mx.random.seed(7)
+    u = nd.random.uniform(0, 1, (2000,)).asnumpy()
+    assert 0.45 < u.mean() < 0.55
+    n = nd.random.normal(2, 3, (2000,)).asnumpy()
+    assert 1.7 < n.mean() < 2.3 and 2.6 < n.std() < 3.4
+    mx.random.seed(7)
+    u2 = nd.random.uniform(0, 1, (2000,)).asnumpy()
+    np.testing.assert_allclose(u, u2)  # reproducible after reseed
+    m = nd.random.multinomial(nd.array(np.array([[0.0, 1.0, 0.0]] * 5,
+                                                "float32"))).asnumpy()
+    assert (m == 1).all()
+
+
+def test_upsampling_and_spatial():
+    x = _a(1, 2, 3, 3)
+    up = nd.UpSampling(nd.array(x), scale=2, sample_type="nearest")
+    assert up.shape == (1, 2, 6, 6)
+    np.testing.assert_allclose(up.asnumpy()[0, 0, :2, :2],
+                               np.full((2, 2), x[0, 0, 0, 0]), rtol=1e-6)
+    # identity affine spatial transformer
+    theta = np.tile(np.array([1, 0, 0, 0, 1, 0], "float32"), (1, 1))
+    st = nd.SpatialTransformer(nd.array(x), nd.array(theta),
+                               target_shape=(3, 3))
+    np.testing.assert_allclose(st.asnumpy(), x, atol=1e-5)
+
+
+def test_optimizer_ops():
+    w, g = _a(5), _a(5)
+    out = nd._internal.sgd_update(nd.array(w), nd.array(g), lr=0.1,
+                                  wd=0.0)
+    np.testing.assert_allclose(out.asnumpy(), w - 0.1 * g, rtol=1e-5)
+    mom = np.zeros(5, "float32")
+    outs = nd._internal.sgd_mom_update(nd.array(w), nd.array(g),
+                                       nd.array(mom), lr=0.1, momentum=0.9)
+    np.testing.assert_allclose(outs[0].asnumpy(), w - 0.1 * g, rtol=1e-5)
+    mean, var = np.zeros(5, "float32"), np.zeros(5, "float32")
+    outs = nd._internal.adam_update(nd.array(w), nd.array(g),
+                                    nd.array(mean), nd.array(var),
+                                    lr=0.01)
+    assert outs[0].shape == (5,)
+
+
+def test_where_and_control():
+    cond = np.array([1, 0, 1], "float32")
+    x, y = _a(3), _a(3)
+    np.testing.assert_allclose(
+        nd.where(nd.array(cond), nd.array(x), nd.array(y)).asnumpy(),
+        np.where(cond != 0, x, y))
+
+
+def test_cast_and_zeros_like():
+    x = _a(2, 2)
+    assert nd.Cast(nd.array(x), dtype="int32").dtype == np.int32
+    np.testing.assert_allclose(nd.zeros_like(nd.array(x)).asnumpy(),
+                               np.zeros_like(x))
+    np.testing.assert_allclose(nd.ones_like(nd.array(x)).asnumpy(),
+                               np.ones_like(x))
+
+
+def test_gather_scatter_nd():
+    data = _a(3, 4)
+    idx = np.array([[0, 2], [1, 3]], "float32")
+    out = nd.gather_nd(nd.array(data), nd.array(idx)).asnumpy()
+    np.testing.assert_allclose(out, data[[0, 2], [1, 3]])
+    sc = nd.scatter_nd(nd.array(np.array([5.0, 7.0], "float32")),
+                       nd.array(idx), shape=(3, 4)).asnumpy()
+    assert sc[0, 1] == 5 and sc[2, 3] == 7 and sc.sum() == 12
+
+
+def test_numeric_gradient_spotcheck():
+    # finite differences vs autograd for a composite expression
+    x0 = _a(3, 3)
+    x = nd.array(x0)
+    x.attach_grad()
+    with autograd.record():
+        y = (nd.tanh(nd.dot(x, x)) * nd.sigmoid(x)).sum()
+    y.backward()
+    eps = 1e-3
+
+    def f(v):
+        t = np.tanh(v @ v) * (1 / (1 + np.exp(-v)))
+        return t.sum()
+
+    num = np.zeros_like(x0)
+    for i in range(3):
+        for j in range(3):
+            p = x0.copy(); p[i, j] += eps
+            m = x0.copy(); m[i, j] -= eps
+            num[i, j] = (f(p) - f(m)) / (2 * eps)
+    np.testing.assert_allclose(x.grad.asnumpy(), num, rtol=1e-2,
+                               atol=1e-3)
+
+
+def test_batchnorm_backward_training():
+    # regression: aux-op (BatchNorm) backward under record used to crash
+    x0 = _a(4, 3, 2, 2)
+    x = nd.array(x0)
+    x.attach_grad()
+    g = nd.array(np.ones(3, "float32"))
+    g.attach_grad()
+    b = nd.array(np.zeros(3, "float32"))
+    b.attach_grad()
+    mm, mv = nd.zeros((3,)), nd.ones((3,))
+    with autograd.record():
+        y = nd.BatchNorm(x, g, b, mm, mv, fix_gamma=False)
+        loss = (y * y).sum()
+    loss.backward()
+    assert x.grad.shape == x0.shape
+    assert np.isfinite(x.grad.asnumpy()).all()
+    assert np.isfinite(g.grad.asnumpy()).all()
+    # moving stats were updated by the recorded training forward
+    assert not np.allclose(mm.asnumpy(), np.zeros(3))
+
+
+def test_trsm_rightside_transpose():
+    a = _a(3, 3)
+    A = np.tril(a) + 3 * np.eye(3, dtype="float32")
+    B = _a(2, 3)
+    # X A^T = B
+    X = nd.linalg.trsm(nd.array(A), nd.array(B), transpose=True,
+                       rightside=True).asnumpy()
+    np.testing.assert_allclose(X @ A.T, B, rtol=1e-4)
+    # X A = B
+    X2 = nd.linalg.trsm(nd.array(A), nd.array(B), rightside=True).asnumpy()
+    np.testing.assert_allclose(X2 @ A, B, rtol=1e-4)
+
+
+def test_gamma_negative_sign():
+    out = nd.gamma(nd.array(np.array([-0.5, -1.5, 0.5], "float32")))
+    from scipy.special import gamma as spgamma  # noqa
+    np.testing.assert_allclose(out.asnumpy(),
+                               [spgamma(-0.5), spgamma(-1.5),
+                                spgamma(0.5)], rtol=1e-4)
+
+
+def test_randint_distribution():
+    mx.random.seed(0)
+    r = mx.random.randint(-5, 5, (4000,)).asnumpy()
+    assert r.min() == -5 and r.max() == 4
+    counts = np.bincount(r + 5, minlength=10)
+    assert (counts > 250).all()  # roughly uniform, all endpoints hit
