@@ -25,6 +25,11 @@ from .ndarray import NDArray
 from . import random_state
 from . import random
 from . import autograd
+from . import symbol
+from . import symbol as sym
+from .symbol import Symbol
+from .executor import Executor
 
 __all__ = ["nd", "ndarray", "autograd", "Context", "cpu", "tpu", "gpu",
-           "random", "NDArray", "TShape", "__version__"]
+           "random", "NDArray", "TShape", "sym", "symbol", "Symbol",
+           "Executor", "__version__"]

@@ -1,0 +1,341 @@
+"""Executor: compiles a bound Symbol graph into XLA executables.
+
+Role analog of GraphExecutor (ref: src/executor/graph_executor.cc
+Init:517, RunOps:1445; include/mxnet/executor.h).  Where the
+reference pre-creates one engine op per node (InitCachedOps:1226) and
+plans a shared memory pool (PlanMemory), here the *entire* graph —
+and for training the fused forward+backward — is traced once and
+handed to XLA as a single jit-compiled executable: fusion, buffer
+reuse, scheduling and async execution all come from the compiler.
+This is the direct TPU analog of the reference's bulk-exec mode
+(MXNET_EXEC_BULK_EXEC_TRAIN) taken to its limit.
+
+Recompilation on new input signatures is automatic via jax.jit's
+shape-keyed cache — the CachedOp pattern (ref:
+src/imperative/cached_op.cc GetForwardGraph:171).
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import random_state
+from .base import np_dtype
+from .context import default_context
+from .ndarray.ndarray import NDArray
+from .symbol.symbol import _topo
+
+__all__ = ["Executor", "build_graph_fn"]
+
+
+def build_graph_fn(symbol):
+    """Build the pure evaluation function of a Symbol graph.
+
+    Returns fn(arg_vals: dict, aux_vals: dict, rng, is_train) ->
+    (outputs: list, aux_updates: dict) suitable for jax.jit
+    (is_train static).
+    """
+    order = _topo(symbol._heads)
+    heads = list(symbol._heads)
+
+    def run(arg_vals, aux_vals, rng, is_train):
+        env = {}
+        aux_updates = {}
+        rng_counter = 0
+        for node in order:
+            if node.is_variable:
+                if node.name in arg_vals:
+                    env[(id(node), 0)] = arg_vals[node.name]
+                elif node.name in aux_vals:
+                    env[(id(node), 0)] = aux_vals[node.name]
+                else:
+                    raise KeyError(
+                        f"unbound variable '{node.name}'")
+                continue
+            op = node.op
+            ins = [env[(id(n), i)] for n, i in node.inputs]
+            params = dict(node.params)
+            if op.needs_mode:
+                params["_training"] = is_train
+            if op.needs_rng:
+                params["_rng"] = jax.random.fold_in(rng, rng_counter)
+                rng_counter += 1
+            outs = op.fn(*ins, **params)
+            outs_list = list(outs) if isinstance(outs, (tuple, list)) \
+                else [outs]
+            if op.num_aux and is_train:
+                aux_new = outs_list[-op.num_aux:]
+                outs_list = outs_list[:-op.num_aux]
+                aux_nodes = node.inputs[-op.num_aux:]
+                for (anode, _), val in zip(aux_nodes, aux_new):
+                    aux_updates[anode.name] = val
+            for i, o in enumerate(outs_list):
+                env[(id(node), i)] = o
+        outputs = [env[(id(n), i)] for n, i in heads]
+        return outputs, aux_updates
+
+    return run
+
+
+def _ones_ct(o):
+    if jnp.issubdtype(o.dtype, jnp.floating):
+        return jnp.ones(o.shape, o.dtype)
+    return np.zeros(o.shape, jax.dtypes.float0)
+
+
+class Executor:
+    """A bound, compiled computation graph
+    (ref: include/mxnet/executor.h Forward/Backward)."""
+
+    def __init__(self, symbol, ctx=None, args=None, args_grad=None,
+                 grad_req="write", aux_states=None, shared_exec=None):
+        self._symbol = symbol
+        self._ctx = ctx or default_context()
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+
+        self.arg_dict = self._normalize(args, arg_names, "args")
+        self.aux_dict = self._normalize(aux_states, aux_names,
+                                        "aux_states", allow_empty=True)
+        if isinstance(grad_req, str):
+            self._grad_req = {n: grad_req for n in arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            self._grad_req = dict(zip(arg_names, grad_req))
+        else:
+            self._grad_req = {n: grad_req.get(n, "null")
+                              for n in arg_names}
+        if args_grad is None:
+            args_grad = {}
+        if isinstance(args_grad, (list, tuple)):
+            args_grad = dict(zip(arg_names, args_grad))
+        self.grad_dict = {
+            n: args_grad.get(n) for n in arg_names
+            if self._grad_req.get(n, "null") != "null"
+            and args_grad.get(n) is not None}
+
+        self._run = build_graph_fn(symbol)
+        self._jit_fwd = {}
+        self._jit_fwd_bwd = {}
+        self._outputs = None
+        self._last_rng = None
+
+    # ------------------------------------------------------------- helpers
+    @staticmethod
+    def _normalize(vals, names, what, allow_empty=False):
+        if vals is None:
+            if allow_empty:
+                return {}
+            raise ValueError(f"{what} must be provided to bind")
+        if isinstance(vals, (list, tuple)):
+            if len(vals) != len(names):
+                raise ValueError(
+                    f"{what}: expected {len(names)} entries "
+                    f"({names}), got {len(vals)}")
+            return dict(zip(names, vals))
+        return dict(vals)
+
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self._symbol.list_arguments()]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n)
+                for n in self._symbol.list_arguments()]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[n]
+                for n in self._symbol.list_auxiliary_states()]
+
+    @property
+    def outputs(self):
+        if self._outputs is None:
+            raise RuntimeError("call forward() first")
+        return self._outputs
+
+    @property
+    def output_shapes(self):
+        _, out_shapes, _ = self._symbol.infer_shape(
+            **{k: v.shape for k, v in self.arg_dict.items()})
+        return out_shapes
+
+    def _jvals(self, d):
+        return {k: v._data for k, v in d.items() if v is not None}
+
+    # ------------------------------------------------------------- forward
+    def _get_fwd(self, is_train):
+        if is_train not in self._jit_fwd:
+            run = self._run
+
+            def f(arg_vals, aux_vals, rng):
+                return run(arg_vals, aux_vals, rng, is_train)
+            self._jit_fwd[is_train] = jax.jit(f)
+        return self._jit_fwd[is_train]
+
+    def forward(self, is_train=False, **kwargs):
+        """Run forward; returns output NDArrays
+        (ref: graph_executor.cc Forward:81)."""
+        for k, v in kwargs.items():
+            if k in self.arg_dict:
+                if isinstance(v, NDArray):
+                    self.arg_dict[k]._data = v._data.astype(
+                        self.arg_dict[k]._data.dtype)
+                else:
+                    self.arg_dict[k]._data = jnp.asarray(
+                        v, self.arg_dict[k]._data.dtype)
+        rng = random_state.next_key()
+        self._last_rng = rng
+        outs, aux_upd = self._get_fwd(bool(is_train))(
+            self._jvals(self.arg_dict), self._jvals(self.aux_dict), rng)
+        for name, val in aux_upd.items():
+            self.aux_dict[name]._data = val
+        self._outputs = [NDArray(o, self._ctx) for o in outs]
+        return self._outputs
+
+    # ------------------------------------------------------------- backward
+    def _grad_names(self):
+        return [n for n in self._symbol.list_arguments()
+                if self._grad_req.get(n, "null") != "null"
+                and self.grad_dict.get(n) is not None]
+
+    def _get_fwd_bwd(self, with_head_grads):
+        key = with_head_grads
+        if key not in self._jit_fwd_bwd:
+            run = self._run
+            grad_names = tuple(self._grad_names())
+
+            def f(arg_vals, aux_vals, rng, head_cts):
+                others = {k: v for k, v in arg_vals.items()
+                          if k not in grad_names}
+
+                def inner(gvals):
+                    merged = dict(others)
+                    merged.update(zip(grad_names, gvals))
+                    outs, aux_upd = run(merged, aux_vals, rng, True)
+                    return outs, aux_upd
+
+                primals = tuple(arg_vals[n] for n in grad_names)
+                (outs, aux_upd), vjp = jax.vjp(inner, primals)
+                if head_cts is None:
+                    cts = [_ones_ct(o) for o in outs]
+                else:
+                    cts = [c if c is not None else _ones_ct(o)
+                           for c, o in zip(head_cts, outs)]
+                aux_ct = {k: (np.zeros(v.shape, jax.dtypes.float0)
+                              if not jnp.issubdtype(v.dtype, jnp.floating)
+                              else jnp.zeros(v.shape, v.dtype))
+                          for k, v in aux_upd.items()}
+                (gvals,) = vjp((cts, aux_ct))
+                return outs, aux_upd, dict(zip(grad_names, gvals))
+
+            if with_head_grads:
+                self._jit_fwd_bwd[key] = jax.jit(f)
+            else:
+                self._jit_fwd_bwd[key] = jax.jit(
+                    lambda a, x, r: f(a, x, r, None))
+        return self._jit_fwd_bwd[key]
+
+    def backward(self, out_grads=None):
+        """Compute gradients into grad arrays honoring grad_req
+        (ref: graph_executor.cc Backward:94).  Fused with a forward
+        replay in one XLA executable; prefer forward_backward() in
+        training loops to avoid the separate forward."""
+        self.forward_backward(out_grads=out_grads, _refresh_outputs=False)
+
+    def forward_backward(self, out_grads=None, _refresh_outputs=True,
+                         **kwargs):
+        """One fused XLA call computing outputs + all gradients —
+        the hot training path (bulk-exec analog)."""
+        for k, v in kwargs.items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._data = v._data if isinstance(v, NDArray)\
+                    else jnp.asarray(v)
+        rng = self._last_rng if not _refresh_outputs and \
+            self._last_rng is not None else random_state.next_key()
+        self._last_rng = rng
+        args_j = self._jvals(self.arg_dict)
+        aux_j = self._jvals(self.aux_dict)
+        if out_grads is not None:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            cts = [g._data if isinstance(g, NDArray) else g
+                   for g in out_grads]
+            outs, aux_upd, grads = self._get_fwd_bwd(True)(
+                args_j, aux_j, rng, cts)
+        else:
+            outs, aux_upd, grads = self._get_fwd_bwd(False)(
+                args_j, aux_j, rng)
+        for name, val in aux_upd.items():
+            self.aux_dict[name]._data = val
+        for name, g in grads.items():
+            buf = self.grad_dict.get(name)
+            if buf is None:
+                continue
+            if self._grad_req.get(name) == "add":
+                buf._data = buf._data + g
+            else:
+                buf._data = g
+        self._outputs = [NDArray(o, self._ctx) for o in outs]
+        return self._outputs
+
+    # ------------------------------------------------------------- misc
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for k, v in (arg_params or {}).items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._data = v._data.astype(
+                    self.arg_dict[k]._data.dtype)
+            elif not allow_extra_params:
+                raise ValueError(f"unknown argument {k}")
+        for k, v in (aux_params or {}).items():
+            if k in self.aux_dict:
+                self.aux_dict[k]._data = v._data.astype(
+                    self.aux_dict[k]._data.dtype)
+            elif not allow_extra_params:
+                raise ValueError(f"unknown aux state {k}")
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False,
+                **kwargs):
+        """Rebind with new input shapes; XLA recompiles lazily via the
+        shape-keyed jit cache, so this is just buffer reallocation."""
+        shapes = {k: v.shape for k, v in self.arg_dict.items()}
+        shapes.update(kwargs)
+        return Executor._simple_bind(
+            self._symbol, self._ctx,
+            self._grad_req, None, shapes, _copy_from=self)
+
+    @classmethod
+    def _simple_bind(cls, symbol, ctx, grad_req, type_dict, shape_kwargs,
+                     _copy_from=None):
+        """Allocate all arrays from inferred shapes and bind
+        (ref: MXExecutorSimpleBind, c_api_executor.cc:220)."""
+        arg_shapes, _, aux_shapes = symbol.infer_shape(**shape_kwargs)
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        type_dict = type_dict or {}
+        args = {}
+        for n, s in zip(arg_names, arg_shapes):
+            dt = np_dtype(type_dict.get(n, "float32"))
+            args[n] = NDArray(jnp.zeros(s, dt), ctx)
+        aux = {}
+        for n, s in zip(aux_names, aux_shapes):
+            dt = np_dtype(type_dict.get(n, "float32"))
+            aux[n] = NDArray(jnp.zeros(s, dt), ctx)
+        if isinstance(grad_req, str):
+            req = {n: grad_req for n in arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            req = dict(zip(arg_names, grad_req))
+        else:
+            req = dict(grad_req)
+        grads = {n: NDArray(jnp.zeros_like(args[n]._data), ctx)
+                 for n in arg_names if req.get(n, "null") != "null"}
+        ex = cls(symbol, ctx, args, grads, req, aux)
+        if _copy_from is not None:
+            for k, v in _copy_from.arg_dict.items():
+                if k in ex.arg_dict and v.shape == ex.arg_dict[k].shape:
+                    ex.arg_dict[k]._data = v._data
+            for k, v in _copy_from.aux_dict.items():
+                if k in ex.aux_dict and v.shape == ex.aux_dict[k].shape:
+                    ex.aux_dict[k]._data = v._data
+        return ex

@@ -25,7 +25,7 @@ from .registry import defop
 # ---------------------------------------------------------------------------
 
 
-@defop("FullyConnected")
+@defop("FullyConnected", arg_names=["data", "weight", "bias"])
 def fully_connected(data, weight, bias=None, num_hidden=0, no_bias=False,
                     flatten=True):
     """y = x W^T + b (ref: src/operator/fully_connected.cc)."""
@@ -53,7 +53,8 @@ def _tup(v, n, default):
     return t if len(t) == n else t + (default,) * (n - len(t))
 
 
-@defop("Convolution", aliases=["Convolution_v1"])
+@defop("Convolution", aliases=["Convolution_v1"],
+       arg_names=["data", "weight", "bias"])
 def convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
                 pad=(), num_filter=0, num_group=1, workspace=1024,
                 no_bias=False, cudnn_tune=None, cudnn_off=False,
@@ -78,7 +79,7 @@ def convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
     return out
 
 
-@defop("Deconvolution")
+@defop("Deconvolution", arg_names=["data", "weight", "bias"])
 def deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
                   pad=(), adj=(), target_shape=(), num_filter=0,
                   num_group=1, workspace=1024, no_bias=True,

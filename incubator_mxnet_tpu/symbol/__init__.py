@@ -1,0 +1,11 @@
+"""``sym`` namespace: Symbol plus the generated symbolic op surface."""
+import sys as _sys
+
+from .symbol import (Symbol, Variable, var, Group, load, load_json,
+                     NameManager)
+from . import register as _register
+
+_internal = _register.populate(_sys.modules[__name__])
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
+           "NameManager"]

@@ -1,0 +1,49 @@
+"""Generate the symbolic op surface from the central registry
+(mirror of ndarray/register.py; ref: python/mxnet/symbol/register.py).
+"""
+import types
+
+from ..ops.registry import OPS
+from .symbol import Symbol, _invoke
+
+
+def make_sym_func(opname, op):
+    def f(*args, name=None, attr=None, **kwargs):
+        sym_args = [a for a in args if isinstance(a, Symbol)]
+        if not op.variadic:
+            for an in op.arg_names[len(sym_args):]:
+                if an in kwargs and isinstance(kwargs[an], Symbol):
+                    sym_args.append(kwargs.pop(an))
+                else:
+                    break
+            # aux inputs may also be passed by keyword
+            if len(sym_args) >= len(op.arg_names):
+                for an in op.aux_names[
+                        len(sym_args) - len(op.arg_names):]:
+                    if an in kwargs and isinstance(kwargs[an], Symbol):
+                        sym_args.append(kwargs.pop(an))
+                    else:
+                        break
+        params = {k: v for k, v in kwargs.items()
+                  if not isinstance(v, Symbol) and v is not None}
+        out = _invoke(op, sym_args, params, name)
+        if attr:
+            out._set_attr(**attr)
+        return out
+
+    f.__name__ = opname
+    f.__qualname__ = opname
+    f.__doc__ = (op.doc or "") + "\n\n(auto-generated symbolic wrapper)"
+    return f
+
+
+def populate(sym_module):
+    internal = types.ModuleType(sym_module.__name__ + "._internal")
+    internal.__doc__ = "Internal (underscore) symbolic operators."
+    for name, op in OPS.items():
+        fn = make_sym_func(name, op)
+        setattr(internal, name, fn)
+        if not name.startswith("_") and not hasattr(sym_module, name):
+            setattr(sym_module, name, fn)
+    sym_module._internal = internal
+    return internal

@@ -1,0 +1,477 @@
+"""Symbol: the declarative graph IR.
+
+Role analog of nnvm::Symbol + Graph in the reference (ref:
+python/mxnet/symbol/symbol.py, nnvm Op/Symbol/Graph; SURVEY.md §2.3).
+A Symbol is a list of (node, output-index) heads over a DAG whose
+nodes are either variables or registered ops.  Instead of lowering to
+engine pushes per node, `bind` compiles the *whole* graph into one
+XLA executable (see executor.py) — the TPU-native answer to
+GraphExecutor's InitCachedOps/PlanMemory machinery, which XLA's
+fusion + buffer assignment replaces wholesale.
+"""
+import ast
+import json
+import threading
+
+from ..ops.registry import OPS, get_op
+from ..ops.shape_hooks import HOOKS
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
+           "NameManager"]
+
+
+class NameManager:
+    """Auto-naming for anonymous ops (ref: python/mxnet/name.py)."""
+
+    _lock = threading.Lock()
+    _counters = {}
+
+    @classmethod
+    def next_name(cls, prefix):
+        prefix = prefix.lower().lstrip("_")
+        with cls._lock:
+            idx = cls._counters.get(prefix, 0)
+            cls._counters[prefix] = idx + 1
+        return f"{prefix}{idx}"
+
+    @classmethod
+    def reset(cls):
+        with cls._lock:
+            cls._counters = {}
+
+
+class _Node:
+    """Graph node: op is None for variables."""
+
+    __slots__ = ("op", "name", "inputs", "params", "attrs")
+
+    def __init__(self, op, name, inputs=(), params=None, attrs=None):
+        self.op = op
+        self.name = name
+        self.inputs = list(inputs)   # [(Node, out_index)]
+        self.params = dict(params or {})
+        self.attrs = dict(attrs or {})
+
+    @property
+    def is_variable(self):
+        return self.op is None
+
+    @property
+    def is_aux(self):
+        return self.attrs.get("__is_aux__") == "1"
+
+    def n_outputs(self):
+        return 1 if self.op is None else self.op.n_outputs(self.params)
+
+
+def _topo(heads):
+    """Topological order of all nodes reachable from head entries."""
+    order, seen = [], set()
+    stack = [(h[0], False) for h in reversed(heads)]
+    while stack:
+        node, done = stack.pop()
+        if done:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for inp, _ in reversed(node.inputs):
+            if id(inp) not in seen:
+                stack.append((inp, False))
+    return order
+
+
+class Symbol:
+    """Handle to one or more output entries of a graph."""
+
+    def __init__(self, heads):
+        self._heads = list(heads)  # [(Node, out_idx)]
+
+    # -------------------------------------------------------------- info
+    @property
+    def name(self):
+        if len(self._heads) == 1:
+            return self._heads[0][0].name
+        return None
+
+    def __repr__(self):
+        return f"<Symbol {self.name or [h[0].name for h in self._heads]}>"
+
+    def __len__(self):
+        return len(self._heads)
+
+    def __iter__(self):
+        for i in range(len(self._heads)):
+            yield self[i]
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            names = self.list_outputs()
+            if index not in names:
+                raise ValueError(f"no output named {index}; have {names}")
+            index = names.index(index)
+        return Symbol([self._heads[index]])
+
+    def __call__(self, *args, **kwargs):
+        raise NotImplementedError("Symbol composition via call is not "
+                                  "supported; compose via op functions")
+
+    # -------------------------------------------------------------- listing
+    def list_arguments(self):
+        return [n.name for n in _topo(self._heads)
+                if n.is_variable and not n.is_aux]
+
+    def list_outputs(self):
+        out = []
+        for node, idx in self._heads:
+            if node.is_variable:
+                out.append(node.name)
+            elif node.n_outputs() == 1:
+                out.append(node.name + "_output")
+            else:
+                out.append(f"{node.name}_output{idx}")
+        return out
+
+    def list_auxiliary_states(self):
+        return [n.name for n in _topo(self._heads)
+                if n.is_variable and n.is_aux]
+
+    def list_inputs(self):
+        return [n.name for n in _topo(self._heads) if n.is_variable]
+
+    def get_internals(self):
+        """Symbol exposing every internal output entry
+        (ref: symbol.py get_internals)."""
+        heads = []
+        for n in _topo(self._heads):
+            for i in range(n.n_outputs()):
+                heads.append((n, i))
+        return Symbol(heads)
+
+    def get_children(self):
+        kids = []
+        for node, _ in self._heads:
+            kids.extend(node.inputs)
+        return Symbol(kids) if kids else None
+
+    # -------------------------------------------------------------- attrs
+    def attr(self, key):
+        if len(self._heads) == 1:
+            return self._heads[0][0].attrs.get(key)
+        return None
+
+    def _set_attr(self, **kwargs):
+        for node, _ in self._heads:
+            node.attrs.update({k: str(v) for k, v in kwargs.items()})
+
+    def attr_dict(self):
+        out = {}
+        for n in _topo(self._heads):
+            if n.attrs:
+                out[n.name] = dict(n.attrs)
+        return out
+
+    # -------------------------------------------------------------- compose
+    def _entry(self):
+        if len(self._heads) != 1:
+            raise ValueError("operation requires a single-output symbol")
+        return self._heads[0]
+
+    def _binary(self, opname, scalar_opname, other, reverse=False):
+        if isinstance(other, Symbol):
+            a, b = (other, self) if reverse else (self, other)
+            return _invoke(get_op(opname), [a, b], {})
+        name = scalar_opname
+        return _invoke(get_op(name), [self], {"scalar": other})
+
+    def __add__(self, o):
+        return self._binary("broadcast_add", "_plus_scalar", o)
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binary("broadcast_sub", "_minus_scalar", o)
+
+    def __rsub__(self, o):
+        return _invoke(get_op("_rminus_scalar"), [self], {"scalar": o})
+
+    def __mul__(self, o):
+        return self._binary("broadcast_mul", "_mul_scalar", o)
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binary("broadcast_div", "_div_scalar", o)
+
+    def __rtruediv__(self, o):
+        return _invoke(get_op("_rdiv_scalar"), [self], {"scalar": o})
+
+    def __pow__(self, o):
+        return self._binary("broadcast_power", "_power_scalar", o)
+
+    def __neg__(self):
+        return _invoke(get_op("negative"), [self], {})
+
+    def __getattr__(self, item):
+        # method-style op calls: sym_instance.reshape(...), .sum(), ...
+        if item.startswith("_"):
+            raise AttributeError(item)
+        op = OPS.get(item) or OPS.get({"reshape": "Reshape",
+                                       "flatten": "Flatten"}.get(item, ""))
+        if op is None:
+            raise AttributeError(item)
+
+        def method(*args, **kwargs):
+            return _invoke(op, [self] + [a for a in args
+                                         if isinstance(a, Symbol)],
+                           {k: v for k, v in kwargs.items()
+                            if not isinstance(v, Symbol)})
+        return method
+
+    # -------------------------------------------------------------- infer
+    def infer_shape(self, *args, **kwargs):
+        """Returns (arg_shapes, out_shapes, aux_shapes); None entries
+        where inference failed (ref: symbol.py infer_shape:908)."""
+        return self._infer_shape_impl(False, *args, **kwargs)
+
+    def infer_shape_partial(self, *args, **kwargs):
+        return self._infer_shape_impl(True, *args, **kwargs)
+
+    def _infer_shape_impl(self, partial, *args, **kwargs):
+        import jax
+        import numpy as np
+
+        known = {}
+        if args:
+            for name, s in zip(self.list_arguments(), args):
+                if s is not None:
+                    known[name] = tuple(s)
+        known.update({k: tuple(v) for k, v in kwargs.items()
+                      if v is not None})
+
+        order = _topo(self._heads)
+        shapes = {}   # (id(node), idx) -> shape
+        dtypes = {}
+        for node in order:
+            if node.is_variable:
+                if node.name in known:
+                    shapes[(id(node), 0)] = known[node.name]
+                    dtypes[(id(node), 0)] = np.dtype(
+                        node.attrs.get("__dtype__", "float32"))
+                continue
+            in_keys = [(id(n), i) for n, i in node.inputs]
+            in_shapes = [shapes.get(k) for k in in_keys]
+            hookfn = HOOKS.get(node.op.name)
+            if hookfn and any(s is None for s in in_shapes):
+                filled = hookfn(in_shapes, node.params)
+                for (inode, iidx), s_old, s_new in zip(
+                        node.inputs, in_shapes, filled):
+                    if s_old is None and s_new is not None \
+                            and inode.is_variable:
+                        shapes[(id(inode), 0)] = tuple(s_new)
+                        dtypes[(id(inode), 0)] = np.dtype(
+                            inode.attrs.get("__dtype__", "float32"))
+                in_shapes = [shapes.get(k) for k in in_keys]
+            if any(s is None for s in in_shapes):
+                continue  # leave outputs unknown
+            structs = [jax.ShapeDtypeStruct(
+                s, dtypes.get(k, np.dtype("float32")))
+                for s, k in zip(in_shapes, in_keys)]
+            params = dict(node.params)
+            if node.op.needs_mode:
+                params["_training"] = False
+            if node.op.needs_rng:
+                params["_rng"] = jax.ShapeDtypeStruct((2,),
+                                                      np.dtype("uint32"))
+            try:
+                out = jax.eval_shape(
+                    lambda *xs, _p=params, _f=node.op.fn: _f(*xs, **_p),
+                    *structs)
+            except Exception as e:
+                raise ValueError(
+                    f"shape inference failed at op '{node.op.name}' "
+                    f"(node '{node.name}') with input shapes "
+                    f"{in_shapes}: {e}") from None
+            outs = out if isinstance(out, (tuple, list)) else [out]
+            for i, o in enumerate(outs):
+                shapes[(id(node), i)] = tuple(o.shape)
+                dtypes[(id(node), i)] = np.dtype(o.dtype)
+
+        def _get(name_list):
+            out = []
+            by_name = {n.name: n for n in order if n.is_variable}
+            for nm in name_list:
+                node = by_name[nm]
+                out.append(shapes.get((id(node), 0)))
+            return out
+
+        arg_shapes = _get(self.list_arguments())
+        aux_shapes = _get(self.list_auxiliary_states())
+        out_shapes = [shapes.get((id(n), i)) for n, i in self._heads]
+        if not partial:
+            missing = [nm for nm, s in zip(self.list_arguments(),
+                                           arg_shapes) if s is None]
+            if missing:
+                raise ValueError(
+                    f"infer_shape incomplete; unknown shapes for "
+                    f"arguments {missing} — provide input shapes")
+        self._cached_dtypes = dtypes
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_type(self, *args, **kwargs):
+        """Crude dtype inference: float32 defaults, overridable via
+        variable __dtype__ attrs (full fidelity via executor)."""
+        import numpy as np
+        args_d = [np.dtype(n.attrs.get("__dtype__", "float32"))
+                  for n in _topo(self._heads)
+                  if n.is_variable and not n.is_aux]
+        outs = [np.dtype("float32")] * len(self._heads)
+        auxs = [np.dtype(n.attrs.get("__dtype__", "float32"))
+                for n in _topo(self._heads)
+                if n.is_variable and n.is_aux]
+        return args_d, outs, auxs
+
+    # -------------------------------------------------------------- grad
+    def gradient(self, wrt):
+        raise NotImplementedError(
+            "use Executor.backward (whole-graph vjp) instead of "
+            "symbolic gradient graphs")
+
+    # -------------------------------------------------------------- json
+    def tojson(self):
+        """Serialize the graph (schema mirrors the reference's nnvm
+        JSON: nodes/arg_nodes/heads; ref: c_api_symbolic.cc:350)."""
+        order = _topo(self._heads)
+        ids = {id(n): i for i, n in enumerate(order)}
+        nodes = []
+        for n in order:
+            entry = {
+                "op": "null" if n.is_variable else n.op.name,
+                "name": n.name,
+                "inputs": [[ids[id(inode)], iidx, 0]
+                           for inode, iidx in n.inputs],
+            }
+            attrs = {}
+            if n.params:
+                attrs.update({k: repr(v) for k, v in n.params.items()})
+            if n.attrs:
+                attrs.update({f"__attr_{k}__" if not k.startswith("__")
+                              else k: str(v) for k, v in n.attrs.items()})
+            if attrs:
+                entry["attrs"] = attrs
+            nodes.append(entry)
+        payload = {
+            "nodes": nodes,
+            "arg_nodes": [ids[id(n)] for n in order if n.is_variable],
+            "heads": [[ids[id(n)], i, 0] for n, i in self._heads],
+            "attrs": {"framework": "incubator_mxnet_tpu",
+                      "version": "0.1.0"},
+        }
+        return json.dumps(payload, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # -------------------------------------------------------------- bind
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
+                    **kwargs):
+        from ..executor import Executor
+        return Executor._simple_bind(self, ctx, grad_req, type_dict,
+                                     kwargs)
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        from ..executor import Executor
+        return Executor(self, ctx, args, args_grad, grad_req, aux_states,
+                        shared_exec=shared_exec)
+
+    def eval(self, ctx=None, **kwargs):
+        ex = self.bind(ctx, kwargs)
+        return ex.forward()
+
+    # convenience mirrors of the nd API
+    def get_backend_symbol(self, backend):
+        return self
+
+
+def _to_symbol_entry(s):
+    return s._entry()
+
+
+def _invoke(op, sym_args, params, name=None):
+    """Create a graph node from symbolic inputs; auto-create variables
+    for missing parameter/aux inputs (matches the reference's
+    auto-created fc1_weight etc.)."""
+    name = name or NameManager.next_name(op.name)
+    inputs = [s._entry() for s in sym_args]
+    if not op.variadic:
+        needed = list(op.arg_names) + list(op.aux_names)
+        for i in range(len(inputs), len(needed)):
+            argname = needed[i]
+            if argname == "bias" and params.get("no_bias", False):
+                continue
+            is_aux = i >= len(op.arg_names)
+            attrs = {"__is_aux__": "1"} if is_aux else {}
+            v = _Node(None, f"{name}_{argname}", attrs=attrs)
+            inputs.append((v, 0))
+    node = _Node(op, name, inputs, params)
+    return Symbol([(node, i) for i in range(node.n_outputs())]
+                  if node.n_outputs() > 1 else [(node, 0)])
+
+
+def Variable(name, attr=None, shape=None, dtype=None, init=None, **kwargs):
+    """Create a variable symbol (ref: symbol.py var)."""
+    attrs = dict(attr or {})
+    if shape is not None:
+        attrs["__shape__"] = str(tuple(shape))
+    if dtype is not None:
+        attrs["__dtype__"] = str(dtype)
+    if init is not None:
+        attrs["__init__"] = init if isinstance(init, str) else \
+            init.dumps() if hasattr(init, "dumps") else str(init)
+    for k, v in kwargs.items():
+        attrs[f"__{k}__"] = str(v)
+    return Symbol([(_Node(None, name, attrs=attrs), 0)])
+
+
+var = Variable
+
+
+def Group(symbols):
+    heads = []
+    for s in symbols:
+        heads.extend(s._heads)
+    return Symbol(heads)
+
+
+def load_json(json_str):
+    payload = json.loads(json_str)
+    nodes = []
+    for entry in payload["nodes"]:
+        attrs_in = entry.get("attrs", {})
+        params, attrs = {}, {}
+        for k, v in attrs_in.items():
+            if k.startswith("__attr_") and k.endswith("__"):
+                attrs[k[len("__attr_"):-2]] = v
+            elif k.startswith("__") and k.endswith("__"):
+                attrs[k] = v
+            else:
+                try:
+                    params[k] = ast.literal_eval(v)
+                except (ValueError, SyntaxError):
+                    params[k] = v
+        if entry["op"] == "null":
+            node = _Node(None, entry["name"], attrs=attrs)
+        else:
+            op = get_op(entry["op"])
+            inputs = [(nodes[i], idx) for i, idx, _ in entry["inputs"]]
+            node = _Node(op, entry["name"], inputs, params, attrs)
+        nodes.append(node)
+    heads = [(nodes[i], idx) for i, idx, _ in payload["heads"]]
+    return Symbol(heads)
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())

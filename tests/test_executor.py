@@ -1,0 +1,148 @@
+"""Executor tests (ref: tests/python/unittest/test_executor.py)."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, sym
+
+RS = np.random.RandomState(3)
+
+
+def test_bind_forward():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = sym.dot(a, b)
+    av = RS.rand(3, 4).astype("float32")
+    bv = RS.rand(4, 5).astype("float32")
+    ex = c.bind(mx.cpu(), {"a": nd.array(av), "b": nd.array(bv)})
+    out = ex.forward()
+    np.testing.assert_allclose(out[0].asnumpy(), av @ bv, rtol=1e-5)
+
+
+def test_backward_grads():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    loss = sym.sum(a * b)
+    av, bv = RS.rand(3, 3).astype("float32"), RS.rand(3, 3).astype(
+        "float32")
+    ga, gb = nd.zeros((3, 3)), nd.zeros((3, 3))
+    ex = loss.bind(mx.cpu(), {"a": nd.array(av), "b": nd.array(bv)},
+                   args_grad={"a": ga, "b": gb})
+    ex.forward(is_train=True)
+    ex.backward()
+    np.testing.assert_allclose(ga.asnumpy(), bv, rtol=1e-5)
+    np.testing.assert_allclose(gb.asnumpy(), av, rtol=1e-5)
+
+
+def test_grad_req_add_and_null():
+    a = sym.Variable("a")
+    loss = sym.sum(a * a)
+    av = RS.rand(2, 2).astype("float32")
+    ga = nd.zeros((2, 2))
+    ex = loss.bind(mx.cpu(), {"a": nd.array(av)}, args_grad={"a": ga},
+                   grad_req="add")
+    for _ in range(2):
+        ex.forward(is_train=True)
+        ex.backward()
+    np.testing.assert_allclose(ga.asnumpy(), 2 * 2 * av, rtol=1e-5)
+    ex2 = loss.bind(mx.cpu(), {"a": nd.array(av)}, grad_req="null")
+    ex2.forward(is_train=True)
+    ex2.backward()  # no grads requested; must not crash
+
+
+def test_simple_bind_allocates():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=8, name="fc")
+    net = sym.SoftmaxOutput(net, sym.Variable("label"), name="sm")
+    ex = net.simple_bind(mx.cpu(), data=(4, 12), label=(4,))
+    assert ex.arg_dict["fc_weight"].shape == (8, 12)
+    assert ex.grad_dict["fc_weight"].shape == (8, 12)
+    ex.arg_dict["data"][:] = 1.0
+    out = ex.forward(is_train=False)
+    assert out[0].shape == (4, 8)
+
+
+def test_head_gradients():
+    a = sym.Variable("a")
+    out = a * 3.0
+    av = RS.rand(4).astype("float32")
+    ga = nd.zeros((4,))
+    ex = out.bind(mx.cpu(), {"a": nd.array(av)}, args_grad={"a": ga})
+    ex.forward(is_train=True)
+    hg = nd.array(np.array([1, 2, 3, 4], "float32"))
+    ex.backward(out_grads=hg)
+    np.testing.assert_allclose(ga.asnumpy(), 3 * hg.asnumpy(), rtol=1e-5)
+
+
+def test_forward_backward_fused():
+    data = sym.Variable("data")
+    label = sym.Variable("label")
+    fc = sym.FullyConnected(data, num_hidden=3, name="fc")
+    net = sym.SoftmaxOutput(fc, label, name="sm")
+    ex = net.simple_bind(mx.cpu(), data=(5, 7), label=(5,))
+    ex.arg_dict["data"][:] = nd.array(RS.rand(5, 7).astype("float32"))
+    ex.arg_dict["fc_weight"][:] = nd.array(
+        RS.rand(3, 7).astype("float32"))
+    ex.arg_dict["label"][:] = nd.array(
+        np.array([0, 1, 2, 0, 1], "float32"))
+    outs = ex.forward_backward()
+    assert outs[0].shape == (5, 3)
+    g = ex.grad_dict["fc_weight"].asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_batchnorm_executor_aux_update():
+    data = sym.Variable("data")
+    net = sym.BatchNorm(data, name="bn", momentum=0.5, fix_gamma=False)
+    ex = net.simple_bind(mx.cpu(), data=(8, 3))
+    x = RS.rand(8, 3).astype("float32") * 4
+    ex.arg_dict["data"][:] = nd.array(x)
+    ex.arg_dict["bn_gamma"][:] = 1.0
+    mm_before = ex.aux_dict["bn_moving_mean"].asnumpy().copy()
+    ex.forward(is_train=True)
+    mm_after = ex.aux_dict["bn_moving_mean"].asnumpy()
+    expected = 0.5 * mm_before + 0.5 * x.mean(0)
+    np.testing.assert_allclose(mm_after, expected, rtol=1e-4)
+    # inference does not touch aux
+    ex.forward(is_train=False)
+    np.testing.assert_allclose(ex.aux_dict["bn_moving_mean"].asnumpy(),
+                               mm_after)
+
+
+def test_dropout_deterministic_backward():
+    # backward must replay the same dropout mask as forward
+    data = sym.Variable("data")
+    net = sym.sum(sym.Dropout(data, p=0.5, name="do"))
+    av = np.ones((64, 64), "float32")
+    ga = nd.zeros((64, 64))
+    ex = net.bind(mx.cpu(), {"data": nd.array(av)},
+                  args_grad={"data": ga})
+    out = ex.forward(is_train=True)
+    ex.backward()
+    g = ga.asnumpy()
+    # gradient is 2.0 where kept, 0 where dropped; sum matches forward
+    np.testing.assert_allclose((g > 0).sum() * 2.0, out[0].asscalar(),
+                               rtol=1e-5)
+
+
+def test_reshape_executor():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=4, name="fc")
+    ex = net.simple_bind(mx.cpu(), data=(2, 6))
+    ex.arg_dict["fc_weight"][:] = 0.5
+    ex2 = ex.reshape(data=(8, 6))
+    assert ex2.arg_dict["data"].shape == (8, 6)
+    np.testing.assert_allclose(ex2.arg_dict["fc_weight"].asnumpy(),
+                               0.5 * np.ones((4, 6)))
+    out = ex2.forward()
+    assert out[0].shape == (8, 4)
+
+
+def test_copy_params_from():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=2, name="fc")
+    ex = net.simple_bind(mx.cpu(), data=(1, 3))
+    ex.copy_params_from({"fc_weight": nd.ones((2, 3)),
+                         "fc_bias": nd.zeros((2,))})
+    ex.arg_dict["data"][:] = 1.0
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(),
+                               [[3.0, 3.0]])

@@ -1,0 +1,132 @@
+"""Symbol tests (ref: tests/python/unittest/test_symbol.py,
+test_infer_shape.py)."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import sym
+
+
+def _mlp():
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = sym.FullyConnected(act, num_hidden=10, name="fc2")
+    return sym.SoftmaxOutput(fc2, sym.Variable("label"), name="softmax")
+
+
+def test_compose_and_listing():
+    net = _mlp()
+    args = net.list_arguments()
+    assert args == ["data", "fc1_weight", "fc1_bias", "fc2_weight",
+                    "fc2_bias", "label"]
+    assert net.list_outputs() == ["softmax_output"]
+    assert net.list_auxiliary_states() == []
+
+
+def test_auto_variable_creation_and_naming():
+    sym.NameManager.reset()
+    x = sym.Variable("x")
+    f = sym.FullyConnected(x, num_hidden=4)
+    assert f.name.startswith("fullyconnected")
+    assert f.list_arguments() == ["x", f.name + "_weight",
+                                  f.name + "_bias"]
+
+
+def test_infer_shape_mlp():
+    net = _mlp()
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(32, 100),
+                                                         label=(32,))
+    d = dict(zip(net.list_arguments(), arg_shapes))
+    assert d["fc1_weight"] == (16, 100)
+    assert d["fc1_bias"] == (16,)
+    assert d["fc2_weight"] == (10, 16)
+    assert out_shapes == [(32, 10)]
+
+
+def test_infer_shape_conv():
+    data = sym.Variable("data")
+    c = sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                        name="conv1")
+    b = sym.BatchNorm(c, name="bn1")
+    p = sym.Pooling(b, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    arg_shapes, out_shapes, aux_shapes = p.infer_shape(
+        data=(4, 3, 16, 16))
+    d = dict(zip(p.list_arguments(), arg_shapes))
+    assert d["conv1_weight"] == (8, 3, 3, 3)
+    assert d["conv1_bias"] == (8,)
+    assert d["bn1_gamma"] == (8,)
+    assert out_shapes == [(4, 8, 8, 8)]
+    ax = dict(zip(p.list_auxiliary_states(), aux_shapes))
+    assert ax["bn1_moving_mean"] == (8,)
+    assert ax["bn1_moving_var"] == (8,)
+
+
+def test_batchnorm_aux_listing():
+    data = sym.Variable("data")
+    b = sym.BatchNorm(data, name="bn")
+    assert b.list_arguments() == ["data", "bn_gamma", "bn_beta"]
+    assert b.list_auxiliary_states() == ["bn_moving_mean",
+                                         "bn_moving_var"]
+
+
+def test_arithmetic_composition():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = (a + b) * 2 - a / b
+    args = c.list_arguments()
+    assert set(args) == {"a", "b"}
+    ex = c.bind(mx.cpu(), {"a": mx.nd.array([2.0]),
+                           "b": mx.nd.array([4.0])})
+    out = ex.forward()
+    np.testing.assert_allclose(out[0].asnumpy(), [(2 + 4) * 2 - 2 / 4])
+
+
+def test_group_and_internals():
+    a = sym.Variable("a")
+    x = sym.relu(a, name="r")
+    y = sym.sigmoid(a, name="s")
+    g = sym.Group([x, y])
+    assert g.list_outputs() == ["r_output", "s_output"]
+    internals = x.get_internals()
+    assert "a" in internals.list_outputs()
+
+
+def test_multi_output_indexing():
+    a = sym.Variable("a")
+    parts = sym.SliceChannel(a, num_outputs=3, axis=1, name="split")
+    assert len(parts) == 3
+    assert parts.list_outputs() == ["split_output0", "split_output1",
+                                    "split_output2"]
+    p1 = parts[1]
+    assert p1.list_outputs() == ["split_output1"]
+
+
+def test_json_roundtrip():
+    net = _mlp()
+    js = net.tojson()
+    net2 = sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    assert net2.list_outputs() == net.list_outputs()
+    arg_shapes, out_shapes, _ = net2.infer_shape(data=(8, 20), label=(8,))
+    assert out_shapes == [(8, 10)]
+
+
+def test_save_load_file(tmp_path):
+    net = _mlp()
+    fname = str(tmp_path / "net.json")
+    net.save(fname)
+    net2 = sym.load(fname)
+    assert net2.list_arguments() == net.list_arguments()
+
+
+def test_method_style_ops():
+    a = sym.Variable("a")
+    out = a.reshape(shape=(2, 2)).sum()
+    ex = out.bind(mx.cpu(), {"a": mx.nd.array([1.0, 2.0, 3.0, 4.0])})
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(), 10.0)
+
+
+def test_variable_attrs():
+    v = sym.Variable("w", shape=(3, 4), lr_mult=2.0)
+    assert v.attr("__shape__") == "(3, 4)"
+    assert v.attr("__lr_mult__") == "2.0"
