@@ -29,7 +29,26 @@ from . import symbol
 from . import symbol as sym
 from .symbol import Symbol
 from .executor import Executor
+from . import io
+from . import initializer
+from .initializer import init
+from . import optimizer
+from .optimizer import Optimizer
+from . import lr_scheduler
+from . import metric
+from . import callback
+from . import kvstore
+from . import model
+from .model import load_checkpoint, save_checkpoint
+from . import module
+from . import module as mod
+from .module import Module
+from .io import DataBatch, DataDesc, DataIter, NDArrayIter
 
 __all__ = ["nd", "ndarray", "autograd", "Context", "cpu", "tpu", "gpu",
            "random", "NDArray", "TShape", "sym", "symbol", "Symbol",
-           "Executor", "__version__"]
+           "Executor", "io", "initializer", "init", "optimizer",
+           "lr_scheduler", "metric", "callback", "kvstore", "model",
+           "module", "mod", "Module", "DataBatch", "DataDesc",
+           "DataIter", "NDArrayIter", "load_checkpoint",
+           "save_checkpoint", "__version__"]

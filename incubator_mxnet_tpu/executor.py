@@ -173,17 +173,23 @@ class Executor:
             self._jit_fwd[is_train] = jax.jit(f)
         return self._jit_fwd[is_train]
 
+    def _set_inputs(self, kwargs):
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise ValueError(
+                    f"unknown argument '{k}'; bound arguments are "
+                    f"{sorted(self.arg_dict)}")
+            if isinstance(v, NDArray):
+                self.arg_dict[k]._data = v._data.astype(
+                    self.arg_dict[k]._data.dtype)
+            else:
+                self.arg_dict[k]._data = jnp.asarray(
+                    v, self.arg_dict[k]._data.dtype)
+
     def forward(self, is_train=False, **kwargs):
         """Run forward; returns output NDArrays
         (ref: graph_executor.cc Forward:81)."""
-        for k, v in kwargs.items():
-            if k in self.arg_dict:
-                if isinstance(v, NDArray):
-                    self.arg_dict[k]._data = v._data.astype(
-                        self.arg_dict[k]._data.dtype)
-                else:
-                    self.arg_dict[k]._data = jnp.asarray(
-                        v, self.arg_dict[k]._data.dtype)
+        self._set_inputs(kwargs)
         rng = random_state.next_key()
         self._last_rng = rng
         outs, aux_upd = self._get_fwd(bool(is_train))(
@@ -247,10 +253,7 @@ class Executor:
                          **kwargs):
         """One fused XLA call computing outputs + all gradients —
         the hot training path (bulk-exec analog)."""
-        for k, v in kwargs.items():
-            if k in self.arg_dict:
-                self.arg_dict[k]._data = v._data if isinstance(v, NDArray)\
-                    else jnp.asarray(v)
+        self._set_inputs(kwargs)
         rng = self._last_rng if not _refresh_outputs and \
             self._last_rng is not None else random_state.next_key()
         self._last_rng = rng

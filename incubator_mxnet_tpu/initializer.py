@@ -1,0 +1,304 @@
+"""Weight initializers (ref: python/mxnet/initializer.py — InitDesc:34,
+Initializer:53, Load:287, Mixed:334, Zero:377, One:402, Constant:426,
+Uniform:442, Normal:475, Orthogonal:508, Xavier:545, MSRAPrelu:611,
+Bilinear:635, LSTMBias:653)."""
+import json
+import re
+
+import numpy as np
+
+from . import nd
+from .utils.registry import get_registry
+
+__all__ = ["InitDesc", "Initializer", "Zero", "One", "Constant",
+           "Uniform", "Normal", "Orthogonal", "Xavier", "MSRAPrelu",
+           "Bilinear", "LSTMBias", "Mixed", "Load", "create", "register"]
+
+_REG = get_registry("initializer")
+register = _REG.register
+
+
+def create(name, **kwargs):
+    if isinstance(name, Initializer):
+        return name
+    return _REG.get(name)(**kwargs)
+
+
+class InitDesc(str):
+    """Name + attrs describing how to init one parameter."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
+
+
+class Initializer:
+    """Base initializer; callable on (InitDesc, NDArray)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        return json.dumps([type(self).__name__.lower(), self._kwargs])
+
+    def __call__(self, desc, arr):
+        if not isinstance(desc, str):
+            raise TypeError("desc must be str/InitDesc")
+        init_attr = getattr(desc, "attrs", {}).get("__init__", "")
+        if init_attr:
+            klass, kwargs = json.loads(init_attr)
+            create(klass, **kwargs)._init_weight(desc, arr)
+            return
+        name = desc.lower()
+        if name.endswith("weight"):
+            self._init_weight(desc, arr)
+        elif name.endswith("bias"):
+            self._init_bias(desc, arr)
+        elif name.endswith("gamma"):
+            self._init_gamma(desc, arr)
+        elif name.endswith("beta"):
+            self._init_beta(desc, arr)
+        elif "moving_mean" in name or "running_mean" in name:
+            self._init_zero(desc, arr)
+        elif ("moving_var" in name or "running_var" in name
+              or "moving_inv_var" in name):
+            self._init_one(desc, arr)
+        elif "moving_avg" in name:
+            self._init_zero(desc, arr)
+        else:
+            self._init_default(desc, arr)
+
+    def _init_weight(self, desc, arr):
+        raise NotImplementedError
+
+    def _init_bias(self, desc, arr):
+        arr[:] = 0.0
+
+    def _init_gamma(self, desc, arr):
+        arr[:] = 1.0
+
+    def _init_beta(self, desc, arr):
+        arr[:] = 0.0
+
+    def _init_zero(self, desc, arr):
+        arr[:] = 0.0
+
+    def _init_one(self, desc, arr):
+        arr[:] = 1.0
+
+    def _init_default(self, desc, arr):
+        self._init_weight(desc, arr)
+
+
+@register("zeros")
+class Zero(Initializer):
+    def _init_weight(self, desc, arr):
+        arr[:] = 0.0
+
+
+@register("ones")
+class One(Initializer):
+    def _init_weight(self, desc, arr):
+        arr[:] = 1.0
+
+
+@register("constant")
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, desc, arr):
+        arr[:] = self.value
+
+
+@register("uniform")
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, desc, arr):
+        nd.random.uniform(-self.scale, self.scale, arr.shape, out=arr)
+
+
+@register("normal")
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, desc, arr):
+        nd.random.normal(0, self.sigma, arr.shape, out=arr)
+
+
+@register("orthogonal")
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, desc, arr):
+        nout = arr.shape[0]
+        nin = int(np.prod(arr.shape[1:]))
+        if self.rand_type == "uniform":
+            tmp = np.random.uniform(-1.0, 1.0, (nout, nin))
+        else:
+            tmp = np.random.normal(0.0, 1.0, (nout, nin))
+        u, _, v = np.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == tmp.shape else v
+        arr[:] = nd.array(self.scale * q.reshape(arr.shape))
+
+
+@register("xavier")
+class Xavier(Initializer):
+    """Glorot init (ref: initializer.py:545)."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg",
+                 magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, desc, arr):
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) < 2:
+            raise ValueError(f"Xavier requires ndim>=2, got {desc} "
+                             f"{shape}")
+        if len(shape) > 2:
+            hw_scale = np.prod(shape[2:])
+        fan_in = shape[1] * hw_scale
+        fan_out = shape[0] * hw_scale
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise ValueError("bad factor_type")
+        scale = np.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            nd.random.uniform(-scale, scale, arr.shape, out=arr)
+        elif self.rnd_type == "gaussian":
+            nd.random.normal(0, scale, arr.shape, out=arr)
+        else:
+            raise ValueError("bad rnd_type")
+
+
+@register("msraprelu")
+class MSRAPrelu(Xavier):
+    """He init for PReLU nets (ref: initializer.py:611)."""
+
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register("bilinear")
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel (ref: initializer.py:635)."""
+
+    def _init_weight(self, desc, arr):
+        weight = np.zeros(int(np.prod(arr.shape)), dtype="float32")
+        shape = arr.shape
+        f = np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(np.prod(shape)):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr[:] = nd.array(weight.reshape(shape))
+
+
+@register("lstmbias")
+class LSTMBias(Initializer):
+    """Forget-gate bias init (ref: initializer.py:653)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        arr[:] = 0.0
+        num_hidden = int(arr.shape[0] / 4)
+        a = arr.asnumpy()
+        a[num_hidden:2 * num_hidden] = self.forget_bias
+        arr[:] = nd.array(a)
+
+    _init_bias = _init_weight
+    _init_default = _init_weight
+
+
+@register("mixed")
+class Mixed(Initializer):
+    """Pattern-routed initializers (ref: initializer.py:334)."""
+
+    def __init__(self, patterns, initializers):
+        super().__init__()
+        if len(patterns) != len(initializers):
+            raise ValueError("patterns and initializers must pair up")
+        self.map = list(zip([re.compile(p) for p in patterns],
+                            initializers))
+
+    def __call__(self, desc, arr):
+        for prog, init in self.map:
+            if prog.match(str(desc)):
+                init(desc, arr)
+                return
+        raise ValueError(f"no initializer pattern matches {desc}; add "
+                         "a '.*' fallback")
+
+
+@register("load")
+class Load:
+    """Init from saved params dict (ref: initializer.py:287)."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        if isinstance(param, str):
+            param = nd.load(param)
+        self.param = {k.split(":", 1)[-1]: v for k, v in param.items()}
+        self.default_init = default_init
+
+    def __call__(self, desc, arr):
+        name = str(desc)
+        if name in self.param:
+            src = self.param[name]
+            if src.shape != arr.shape:
+                raise ValueError(
+                    f"shape mismatch for {name}: saved {src.shape} vs "
+                    f"required {arr.shape}")
+            arr[:] = src
+        else:
+            if self.default_init is None:
+                raise ValueError(f"no saved param and no default init "
+                                 f"for {name}")
+            self.default_init(desc, arr)
+
+
+# `init` namespace alias used as mx.init.Xavier() in the reference
+class _InitModule:
+    InitDesc = InitDesc
+    Initializer = Initializer
+    Zero = Zero
+    One = One
+    Constant = Constant
+    Uniform = Uniform
+    Normal = Normal
+    Orthogonal = Orthogonal
+    Xavier = Xavier
+    MSRAPrelu = MSRAPrelu
+    Bilinear = Bilinear
+    LSTMBias = LSTMBias
+    Mixed = Mixed
+    Load = Load
+
+
+init = _InitModule()

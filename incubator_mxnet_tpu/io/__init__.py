@@ -1,0 +1,13 @@
+"""Data iterators (ref: python/mxnet/io.py, src/io/).
+
+The reference's C++ iterator stack (parser -> augmenter -> batcher ->
+prefetcher) is re-created host-side: numpy/threads feed device
+buffers, with async device transfer riding JAX dispatch.
+"""
+from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
+                 PrefetchingIter, CSVIter, MNISTIter, ImageRecordIter,
+                 LibSVMIter)
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter",
+           "ResizeIter", "PrefetchingIter", "CSVIter", "MNISTIter",
+           "ImageRecordIter", "LibSVMIter"]

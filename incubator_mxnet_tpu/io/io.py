@@ -1,0 +1,433 @@
+"""Data iterator stack.
+
+Role analogs (ref file:line):
+- DataDesc/DataBatch/DataIter: python/mxnet/io.py:43,116,177
+- NDArrayIter: python/mxnet/io.py NDArrayIter (in-memory batcher)
+- PrefetchingIter: python/mxnet/io.py + src/io/iter_prefetcher.h:47
+  (double-buffered background thread)
+- CSVIter: src/io/iter_csv.cc:151
+- MNISTIter: src/io/iter_mnist.cc:80 (reads idx files)
+- LibSVMIter: src/io/iter_libsvm.cc:200
+- ImageRecordIter: src/io/iter_image_recordio_2.cc:660 (RecordIO;
+  full pipeline lands with the recordio milestone — here the class
+  validates args and defers to the image package)
+"""
+import gzip
+import os
+import queue
+import struct
+import threading
+
+import numpy as np
+
+from ..ndarray import array as nd_array
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter",
+           "ResizeIter", "PrefetchingIter", "CSVIter", "MNISTIter",
+           "LibSVMIter", "ImageRecordIter"]
+
+
+class DataDesc:
+    """Name + shape (+dtype/layout) of one input (ref: io.py:43)."""
+
+    def __init__(self, name, shape, dtype=np.float32, layout="NCHW"):
+        self.name = name
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.layout = layout
+
+    def __repr__(self):
+        return f"DataDesc[{self.name},{self.shape},{self.dtype}," \
+               f"{self.layout}]"
+
+    def __iter__(self):  # unpacks like the reference's namedtuple
+        yield self.name
+        yield self.shape
+
+    @staticmethod
+    def get_batch_axis(layout):
+        if layout is None:
+            return 0
+        return layout.find("N")
+
+
+class DataBatch:
+    """One mini-batch (ref: io.py:116)."""
+
+    def __init__(self, data, label=None, pad=0, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label if label is not None else []
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    """Iterator base (ref: io.py:177)."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(self.getdata(), self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        return 0
+
+
+def _init_data(data, allow_empty, default_name):
+    """Normalize data into list of (name, numpy array)."""
+    if data is None:
+        if not allow_empty:
+            raise ValueError("data cannot be None")
+        return []
+    if isinstance(data, (np.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        if len(data) == 1:
+            data = {default_name: data[0]}
+        else:
+            data = {f"_{i}_{default_name}": d for i, d in enumerate(data)}
+    out = []
+    for k, v in data.items():
+        if isinstance(v, NDArray):
+            v = v.asnumpy()
+        out.append((k, np.asarray(v)))
+    return out
+
+
+class NDArrayIter(DataIter):
+    """In-memory batch iterator (ref: python/mxnet/io.py NDArrayIter).
+
+    Supports shuffle, discard/pad/roll-over last-batch handling.
+    """
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, False, data_name)
+        self.label = _init_data(label, True, label_name)
+        self.num_data = self.data[0][1].shape[0]
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.cursor = -batch_size
+        self._order = np.arange(self.num_data)
+        if shuffle:
+            np.random.shuffle(self._order)
+        if last_batch_handle == "discard":
+            self.num_batches = self.num_data // batch_size
+        else:
+            self.num_batches = (self.num_data + batch_size - 1) \
+                // batch_size
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:],
+                         v.dtype) for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:],
+                         v.dtype) for k, v in self.label]
+
+    def reset(self):
+        if self.shuffle:
+            np.random.shuffle(self._order)
+        if self.last_batch_handle == "roll_over" and \
+                self.cursor > self.num_data:
+            self.cursor = -self.batch_size + (self.cursor % self.num_data)
+        else:
+            self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        if self.last_batch_handle == "discard":
+            return self.cursor + self.batch_size <= self.num_data
+        return self.cursor < self.num_data
+
+    def _slice(self, arrays):
+        out = []
+        for _, v in arrays:
+            idx = self._order[self.cursor:self.cursor + self.batch_size]
+            part = v[idx]
+            if len(part) < self.batch_size:  # pad by wrapping
+                extra = self._order[:self.batch_size - len(part)]
+                part = np.concatenate([part, v[extra]], axis=0)
+            out.append(nd_array(part))
+        return out
+
+    def getdata(self):
+        return self._slice(self.data)
+
+    def getlabel(self):
+        return self._slice(self.label)
+
+    def getpad(self):
+        if self.last_batch_handle == "pad" and \
+                self.cursor + self.batch_size > self.num_data:
+            return self.cursor + self.batch_size - self.num_data
+        return 0
+
+
+class ResizeIter(DataIter):
+    """Resize an iterator to a fixed number of batches per epoch
+    (ref: python/mxnet/io.py ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread double buffering (ref: io.py PrefetchingIter /
+    src/io/iter_prefetcher.h:47).  Overlaps host batch prep with
+    device compute — the host-side half of the reference's
+    compute/IO overlap."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 prefetch_depth=2):
+        if not isinstance(iters, (list, tuple)):
+            iters = [iters]
+        super().__init__(iters[0].batch_size)
+        self.iters = iters
+        self.rename_data = rename_data
+        self.rename_label = rename_label
+        self._depth = prefetch_depth
+        self._queue = queue.Queue(maxsize=prefetch_depth)
+        self._stop = threading.Event()
+        self._thread = None
+        self._start()
+
+    def _start(self):
+        def worker():
+            while not self._stop.is_set():
+                try:
+                    batches = [it.next() for it in self.iters]
+                    self._queue.put(batches)
+                except StopIteration:
+                    self._queue.put(None)
+                    return
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    @property
+    def provide_data(self):
+        out = []
+        for i, it in enumerate(self.iters):
+            descs = it.provide_data
+            if self.rename_data:
+                descs = [DataDesc(self.rename_data[i].get(d.name, d.name),
+                                  d.shape, d.dtype) for d in descs]
+            out.extend(descs)
+        return out
+
+    @property
+    def provide_label(self):
+        out = []
+        for i, it in enumerate(self.iters):
+            descs = it.provide_label
+            if self.rename_label:
+                descs = [DataDesc(
+                    self.rename_label[i].get(d.name, d.name),
+                    d.shape, d.dtype) for d in descs]
+            out.extend(descs)
+        return out
+
+    def reset(self):
+        self._stop.set()
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        for it in self.iters:
+            it.reset()
+        self._stop = threading.Event()
+        self._queue = queue.Queue(maxsize=self._depth)
+        self._start()
+
+    def next(self):
+        batches = self._queue.get()
+        if batches is None:
+            raise StopIteration
+        data = [d for b in batches for d in b.data]
+        label = [l for b in batches for l in b.label]
+        return DataBatch(data, label, pad=batches[0].pad)
+
+    def iter_next(self):
+        raise NotImplementedError("use next()")
+
+
+class CSVIter(NDArrayIter):
+    """CSV file iterator (ref: src/io/iter_csv.cc:151)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None,
+                 label_shape=(1,), batch_size=1, round_batch=True,
+                 **kwargs):
+        data = np.loadtxt(data_csv, delimiter=",", dtype=np.float32)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = np.loadtxt(label_csv, delimiter=",",
+                               dtype=np.float32)
+            label = label.reshape((-1,) + tuple(label_shape))
+            if label_shape == (1,):
+                label = label.reshape(-1)
+        super().__init__(data, label, batch_size,
+                         last_batch_handle="pad" if round_batch
+                         else "discard", label_name="label")
+
+
+def _read_idx_images(path):
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        assert magic == 2051, f"bad MNIST image magic {magic}"
+        return np.frombuffer(f.read(), dtype=np.uint8).reshape(
+            n, rows, cols)
+
+
+def _read_idx_labels(path):
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        assert magic == 2049, f"bad MNIST label magic {magic}"
+        return np.frombuffer(f.read(), dtype=np.uint8)
+
+
+class MNISTIter(NDArrayIter):
+    """MNIST idx-format iterator (ref: src/io/iter_mnist.cc:80)."""
+
+    def __init__(self, image="train-images-idx3-ubyte",
+                 label="train-labels-idx1-ubyte", batch_size=128,
+                 shuffle=True, flat=False, seed=0, silent=False,
+                 num_parts=1, part_index=0, **kwargs):
+        imgs = _read_idx_images(image).astype(np.float32) / 255.0
+        lbls = _read_idx_labels(label).astype(np.float32)
+        if num_parts > 1:
+            imgs = imgs[part_index::num_parts]
+            lbls = lbls[part_index::num_parts]
+        if flat:
+            imgs = imgs.reshape(len(imgs), -1)
+        else:
+            imgs = imgs[:, None, :, :]
+        super().__init__(imgs, lbls, batch_size, shuffle=shuffle)
+
+
+class LibSVMIter(DataIter):
+    """LibSVM text-format iterator (ref: src/io/iter_libsvm.cc:200).
+    Yields dense batches (sparse storage arrives with the sparse
+    milestone)."""
+
+    def __init__(self, data_libsvm, data_shape, label_shape=(1,),
+                 batch_size=1, num_parts=1, part_index=0, **kwargs):
+        dim = int(np.prod(data_shape))
+        feats, labels = [], []
+        with open(data_libsvm) as f:
+            for ln in f:
+                parts = ln.strip().split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                row = np.zeros(dim, np.float32)
+                for tok in parts[1:]:
+                    i, v = tok.split(":")
+                    row[int(i)] = float(v)
+                feats.append(row)
+        feats = np.stack(feats)[part_index::num_parts]
+        labels = np.asarray(labels, np.float32)[part_index::num_parts]
+        self._inner = NDArrayIter(feats, labels, batch_size,
+                                  label_name="label")
+        super().__init__(batch_size)
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+    def iter_next(self):
+        return self._inner.iter_next()
+
+
+def ImageRecordIter(*args, **kwargs):
+    """RecordIO image pipeline (ref: iter_image_recordio_2.cc).
+    Provided by the image/recordio milestone."""
+    from ..image.record_iter import ImageRecordIter as _Impl
+    return _Impl(*args, **kwargs)

@@ -1,0 +1,170 @@
+"""KVStore: parameter synchronization facade.
+
+Role analog of the reference KVStore (ref: include/mxnet/kvstore.h:84,
+src/kvstore/kvstore_local.h:50, kvstore_dist.h:49).
+
+TPU-native design (SURVEY.md §2.6/§5): there is no parameter server —
+- 'local'/'device': single-process aggregation across device copies
+  (the reference's Comm reduce, ref: src/kvstore/comm.h:41); sums
+  gradient replicas and broadcasts merged weights.
+- 'tpu' (also accepted: 'dist_sync', 'dist_device_sync', 'nccl'):
+  gradient reduction happens *inside* the compiled training step as
+  `jax.lax.psum` over the ICI mesh (see parallel/data_parallel.py);
+  this class then only holds the replicated master copy and applies
+  the optimizer.  Push/pull on sharded arrays degenerate to local
+  ops because XLA already all-reduced them.
+- 'dist_async' has no ICI analog (ref async PS apply-on-arrival);
+  create() raises with guidance, as decided in SURVEY.md §7.
+"""
+import pickle
+
+from . import optimizer as opt_mod
+from .ndarray.ndarray import NDArray
+
+__all__ = ["KVStore", "create"]
+
+
+class KVStore:
+    """Single-process store with Comm-style aggregation."""
+
+    def __init__(self, kv_type="local"):
+        self.type = kv_type
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+
+    # ------------------------------------------------------------ basics
+    @property
+    def rank(self):
+        import jax
+        return jax.process_index()
+
+    @property
+    def num_workers(self):
+        import jax
+        return jax.process_count()
+
+    def init(self, key, value):
+        """Initialize key(s) with initial weight(s)
+        (ref: kvstore.py init:96)."""
+        for k, v in self._pairs(key, value):
+            if k in self._store:
+                continue
+            vv = v[0] if isinstance(v, (list, tuple)) else v
+            self._store[k] = vv.copy()
+
+    def push(self, key, value, priority=0):
+        """Push gradient(s); aggregates replicas and runs the updater
+        if one is set (ref: kvstore.py push:140)."""
+        for k, v in self._pairs(key, value):
+            vals = v if isinstance(v, (list, tuple)) else [v]
+            merged = vals[0]
+            if len(vals) > 1:
+                merged = vals[0].copy()
+                for extra in vals[1:]:
+                    merged += extra.as_in_context(merged.context)
+            if self._updater is not None:
+                if k not in self._store:
+                    raise KeyError(f"key {k} not initialized")
+                self._updater(self._key_int(k), merged, self._store[k])
+            else:
+                self._store["__grad__" + str(k)] = merged
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        """Pull current weights (or merged grads when no updater)
+        (ref: kvstore.py pull:220)."""
+        for k, o in self._pairs(key, out):
+            src = self._store.get(k)
+            if self._updater is None:
+                src = self._store.get("__grad__" + str(k), src)
+            if src is None:
+                raise KeyError(f"key {k} not initialized")
+            outs = o if isinstance(o, (list, tuple)) else [o]
+            for dst in outs:
+                dst._data = src._data
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only the rows in row_ids (ref: kvstore.py:289) — the
+        embedding-scale path; full sharded-gather arrives with the
+        sparse milestone, semantics (dense gather) already hold."""
+        import jax.numpy as jnp
+        for k, o in self._pairs(key, out):
+            src = self._store.get(k)
+            if src is None:
+                raise KeyError(f"key {k} not initialized")
+            outs = o if isinstance(o, (list, tuple)) else [o]
+            rids = row_ids if isinstance(row_ids, (list, tuple)) \
+                else [row_ids] * len(outs)
+            for dst, rid in zip(outs, rids):
+                idx = rid._data.astype(jnp.int32)
+                rows = jnp.take(src._data, idx, axis=0)
+                full = jnp.zeros_like(src._data).at[idx].set(rows)
+                dst._data = full
+
+    # ------------------------------------------------------------ optimizer
+    def set_updater(self, updater):
+        self._updater = updater
+
+    def set_optimizer(self, optimizer):
+        """Run the optimizer store-side (the reference pickles it to
+        the PS servers, ref: kvstore.py set_optimizer:354)."""
+        self._optimizer = optimizer
+        self._updater = opt_mod.get_updater(optimizer)
+
+    # ------------------------------------------------------------ dist API
+    def barrier(self):
+        import jax
+        if jax.process_count() > 1:
+            # cross-host sync rides a trivial collective
+            from .parallel import host_barrier
+            host_barrier()
+
+    def send_command_to_servers(self, head, body):
+        pass  # no servers: command surface kept for API parity
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise ValueError("no updater/optimizer set")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise ValueError("no updater/optimizer set")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    # ------------------------------------------------------------ helpers
+    @staticmethod
+    def _key_int(k):
+        try:
+            return int(k)
+        except (TypeError, ValueError):
+            return k
+
+    @staticmethod
+    def _pairs(key, value):
+        if isinstance(key, (list, tuple)):
+            if value is None:
+                value = [None] * len(key)
+            return list(zip(key, value))
+        return [(key, value)]
+
+
+def create(name="local"):
+    """Create a KVStore (ref: src/kvstore/kvstore.cc:35)."""
+    name = (name or "local").lower()
+    if name in ("local", "local_update_cpu", "local_allreduce_cpu",
+                "local_allreduce_device", "device"):
+        return KVStore(name)
+    if name in ("tpu", "dist_sync", "dist_device_sync", "dist_sync_device",
+                "nccl", "horovod"):
+        # in-step psum over the mesh does the reduction; store-side
+        # behavior is identical to local
+        return KVStore("tpu")
+    if name == "dist_async":
+        raise ValueError(
+            "dist_async (parameter-server apply-on-arrival) has no ICI "
+            "collective analog on TPU; use 'tpu' (synchronous in-step "
+            "all-reduce) — see SURVEY.md §7 hard-parts #4")
+    raise ValueError(f"unknown kvstore type {name!r}")

@@ -1,0 +1,63 @@
+"""Checkpoint helpers + BatchEndParam (ref: python/mxnet/model.py —
+save_checkpoint/load_checkpoint, BatchEndParam:... , _create_kvstore:57).
+"""
+import collections
+
+from . import kvstore as kvs
+from . import ndarray as nd
+from . import symbol as sym
+
+__all__ = ["BatchEndParam", "save_checkpoint", "load_checkpoint",
+           "_create_kvstore"]
+
+BatchEndParam = collections.namedtuple(
+    "BatchEndParams", ["epoch", "nbatch", "eval_metric", "locals"])
+
+
+def _create_kvstore(kvstore, num_device, arg_params):
+    """(ref: model.py:57) resolve kvstore spec -> (kv, update_on_kvstore)."""
+    if kvstore is None:
+        return None, False
+    if isinstance(kvstore, kvs.KVStore):
+        kv = kvstore
+    elif isinstance(kvstore, str):
+        if num_device == 1 and "dist" not in kvstore and \
+                kvstore != "tpu":
+            return None, False
+        kv = kvs.create(kvstore)
+    else:
+        raise TypeError("kvstore must be KVStore, str or None")
+    update_on_kvstore = True
+    if arg_params:
+        max_size = max(int(nd_arr.size)
+                       for nd_arr in arg_params.values())
+        if max_size > 1024 * 1024 * 16:
+            update_on_kvstore = False
+    return kv, update_on_kvstore
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
+    """Save symbol JSON + params (ref: model.py save_checkpoint).
+    Format: prefix-symbol.json + prefix-NNNN.params."""
+    if symbol is not None:
+        symbol.save(f"{prefix}-symbol.json")
+    save_dict = {f"arg:{k}": v for k, v in arg_params.items()}
+    save_dict.update({f"aux:{k}": v for k, v in aux_params.items()})
+    nd.save(f"{prefix}-{epoch:04d}.params", save_dict)
+
+
+def load_checkpoint(prefix, epoch):
+    """(ref: model.py load_checkpoint) -> (symbol, arg_params, aux_params)."""
+    import os
+    symbol = None
+    if os.path.exists(f"{prefix}-symbol.json"):
+        symbol = sym.load(f"{prefix}-symbol.json")
+    save_dict = nd.load(f"{prefix}-{epoch:04d}.params")
+    arg_params, aux_params = {}, {}
+    for k, v in save_dict.items():
+        tp, name = k.split(":", 1)
+        if tp == "arg":
+            arg_params[name] = v
+        elif tp == "aux":
+            aux_params[name] = v
+    return symbol, arg_params, aux_params

@@ -1,0 +1,281 @@
+"""Module: concrete symbolic trainer over one compiled Executor
+(ref: python/mxnet/module/module.py:  bind:355, init_params,
+init_optimizer:464, forward:560, backward:602, update:619,
+update_metric:726).
+
+TPU-native note: the reference slices each batch across GPUs with
+DataParallelExecutorGroup (ref: executor_group.py:99); here a single
+Executor compiles the whole graph and data parallelism is expressed
+with sharded batch arrays over the device mesh (parallel package), so
+the "group" collapses to one executor whose inputs may be sharded.
+"""
+import logging
+
+from .. import initializer as init_mod
+from .. import optimizer as opt_mod
+from ..initializer import InitDesc
+from ..model import _create_kvstore, save_checkpoint, load_checkpoint
+from ..ndarray.ndarray import NDArray
+from .base_module import BaseModule
+
+__all__ = ["Module"]
+
+
+class Module(BaseModule):
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None):
+        super().__init__(logger)
+        self._symbol = symbol
+        self._data_names = list(data_names or [])
+        self._label_names = list(label_names or [])
+        self._context = context
+        self._fixed_param_names = set(fixed_param_names or [])
+        arg_names = symbol.list_arguments()
+        self._param_names = [n for n in arg_names
+                             if n not in self._data_names
+                             and n not in self._label_names]
+        self._aux_names = symbol.list_auxiliary_states()
+        self._exec = None
+        self._optimizer = None
+        self._updater = None
+        self._kvstore = None
+        self._update_on_kvstore = False
+        self._data_shapes = None
+        self._label_shapes = None
+
+    # ------------------------------------------------------------ bind
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._symbol.list_outputs()
+
+    @property
+    def data_shapes(self):
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        return list(zip(self.output_names, self._exec.output_shapes))
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False,
+             shared_module=None, grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self._data_shapes = [d if hasattr(d, "name") else
+                             _to_desc(d) for d in data_shapes]
+        self._label_shapes = [d if hasattr(d, "name") else _to_desc(d)
+                              for d in (label_shapes or [])]
+        shapes = {d.name: d.shape for d in self._data_shapes}
+        shapes.update({d.name: d.shape for d in self._label_shapes})
+        if isinstance(grad_req, str):
+            req = {}
+            for n in self._symbol.list_arguments():
+                if n in self._fixed_param_names or (
+                        not for_training) or (
+                        n in self._data_names and not inputs_need_grad
+                ) or n in self._label_names:
+                    req[n] = "null"
+                else:
+                    req[n] = grad_req
+        else:
+            req = grad_req
+        self._exec = self._symbol.simple_bind(
+            self._context, grad_req=req, **shapes)
+        if shared_module is not None and shared_module._exec is not None:
+            self._exec.copy_params_from(
+                shared_module._exec.arg_dict,
+                shared_module._exec.aux_dict, allow_extra_params=True)
+        self.binded = True
+
+    # ------------------------------------------------------------ params
+    def init_params(self, initializer=None, arg_params=None,
+                    aux_params=None, allow_missing=False,
+                    force_init=False, allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded, "call bind before init_params"
+        attrs = self._symbol.attr_dict()
+
+        def _fill(name, arr, cache):
+            if cache is not None and name in cache:
+                arr[:] = cache[name]
+                return
+            if cache is not None and not allow_missing:
+                raise RuntimeError(
+                    f"parameter '{name}' missing from provided params "
+                    "(pass allow_missing=True to initialize it)")
+            if initializer is not None:
+                initializer(InitDesc(name, attrs.get(name, {})), arr)
+            elif cache is None:
+                init_mod.Uniform(0.01)(
+                    InitDesc(name, attrs.get(name, {})), arr)
+
+        for name in self._param_names:
+            _fill(name, self._exec.arg_dict[name], arg_params)
+        for name in self._aux_names:
+            _fill(name, self._exec.aux_dict[name], aux_params)
+        self.params_initialized = True
+
+    def get_params(self):
+        arg = {n: self._exec.arg_dict[n].copy()
+               for n in self._param_names}
+        aux = {n: self._exec.aux_dict[n].copy()
+               for n in self._aux_names}
+        return arg, aux
+
+    # ------------------------------------------------------------ optimizer
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            return
+        arg_params = {n: self._exec.arg_dict[n]
+                      for n in self._param_names}
+        kv, update_on_kvstore = _create_kvstore(
+            kvstore, 1, arg_params)
+        if isinstance(optimizer, str):
+            params = dict(optimizer_params or ())
+            # reference default: scale summed grads by 1/batch_size
+            # (ref: module.py init_optimizer:464 rescale_grad)
+            if "rescale_grad" not in params and self._data_shapes:
+                batch_size = self._data_shapes[0].shape[0]
+                if kv is not None and "dist" in getattr(kv, "type", ""):
+                    batch_size *= kv.num_workers
+                params["rescale_grad"] = 1.0 / max(batch_size, 1)
+            idx2name = {i: n for i, n in enumerate(self._param_names)}
+            optimizer = opt_mod.create(
+                optimizer, sym=self._symbol, param_idx2name=idx2name,
+                **params)
+        self._optimizer = optimizer
+        self._kvstore = kv
+        self._update_on_kvstore = update_on_kvstore and kv is not None
+        self._updater = None
+        if kv is not None:
+            for i, name in enumerate(self._param_names):
+                kv.init(i, self._exec.arg_dict[name])
+            if self._update_on_kvstore:
+                kv.set_optimizer(self._optimizer)
+        if not self._update_on_kvstore:
+            self._updater = opt_mod.get_updater(optimizer)
+        self.optimizer_initialized = True
+
+    # ------------------------------------------------------------ step
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        inputs = self._batch_inputs(data_batch)
+        self._exec.forward(is_train=is_train, **inputs)
+
+    def _batch_inputs(self, data_batch):
+        inputs = {}
+        bound = self._exec.arg_dict
+        for desc, arr in zip(self._data_shapes, data_batch.data):
+            inputs[desc.name] = arr
+        if data_batch.label is not None and self._label_shapes:
+            for desc, arr in zip(self._label_shapes, data_batch.label):
+                if desc.name in bound:  # symbol may be label-free
+                    inputs[desc.name] = arr
+        return inputs
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        self._exec.backward(out_grads=out_grads)
+
+    def forward_backward(self, data_batch):
+        """Fused single-XLA-call training step (outputs + grads)."""
+        self._exec.forward_backward(**self._batch_inputs(data_batch))
+
+    def update(self):
+        """(ref: module.py update:619 / model.py
+        _update_params_on_kvstore:105)"""
+        assert self.optimizer_initialized
+        for i, name in enumerate(self._param_names):
+            grad = self._exec.grad_dict.get(name)
+            if grad is None:  # fixed / grad_req=null parameters
+                continue
+            kv = self._kvstore
+            if kv is not None and self._update_on_kvstore:
+                kv.push(i, grad, priority=-i)
+                kv.pull(i, out=self._exec.arg_dict[name], priority=-i)
+            elif kv is not None:
+                kv.push(i, grad, priority=-i)
+                kv.pull(i, out=grad, priority=-i)
+                self._updater(i, grad, self._exec.arg_dict[name])
+            else:
+                self._updater(i, grad, self._exec.arg_dict[name])
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._exec.outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.inputs_need_grad
+        return [self._exec.grad_dict[n] for n in self._data_names]
+
+    def update_metric(self, eval_metric, labels):
+        eval_metric.update(labels, self._exec.outputs)
+
+    def install_monitor(self, mon):
+        mon.install(self._exec)
+
+    # ------------------------------------------------------------ ckpt
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        arg, aux = self.get_params()
+        save_checkpoint(prefix, epoch, self._symbol, arg, aux)
+        if save_optimizer_states:
+            self.save_optimizer_states(f"{prefix}-{epoch:04d}.states")
+
+    def save_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        if self._update_on_kvstore:
+            self._kvstore.save_optimizer_states(fname)
+        else:
+            with open(fname, "wb") as f:
+                f.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+        else:
+            with open(fname, "rb") as f:
+                self._updater.set_states(f.read())
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        mod = Module(symbol, **kwargs)
+        mod._preloaded_params = (arg_params, aux_params)
+        mod._preload_opt_states = \
+            f"{prefix}-{epoch:04d}.states" if load_optimizer_states \
+            else None
+        return mod
+
+    def init_params_from_preloaded(self):
+        if getattr(self, "_preloaded_params", None):
+            arg, aux = self._preloaded_params
+            self.init_params(arg_params=arg, aux_params=aux,
+                             force_init=True)
+
+
+def _to_desc(d):
+    from ..io.io import DataDesc
+    name, shape = d
+    return DataDesc(name, shape)

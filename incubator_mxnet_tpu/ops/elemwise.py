@@ -167,8 +167,8 @@ alias("broadcast_mul", "elemwise_mul", "_mul")
 alias("broadcast_div", "elemwise_div", "_div")
 alias("broadcast_mod", "_mod")
 alias("broadcast_power", "_power")
-alias("broadcast_maximum", "_maximum")
-alias("broadcast_minimum", "_minimum")
+alias("broadcast_maximum", "_maximum", "maximum")
+alias("broadcast_minimum", "_minimum", "minimum")
 alias("broadcast_hypot", "_hypot")
 
 

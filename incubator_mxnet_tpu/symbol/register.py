@@ -9,7 +9,14 @@ from .symbol import Symbol, _invoke
 
 def make_sym_func(opname, op):
     def f(*args, name=None, attr=None, **kwargs):
-        sym_args = [a for a in args if isinstance(a, Symbol)]
+        for a in args:
+            if not isinstance(a, Symbol):
+                raise TypeError(
+                    f"sym.{opname} positional inputs must be Symbols, "
+                    f"got {type(a).__name__}; for scalar operands use "
+                    f"the *_scalar internal ops or Python operators "
+                    f"(e.g. `x + 3`, sym._internal._maximum_scalar)")
+        sym_args = list(args)
         if not op.variadic:
             for an in op.arg_names[len(sym_args):]:
                 if an in kwargs and isinstance(kwargs[an], Symbol):

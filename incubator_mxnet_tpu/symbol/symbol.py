@@ -409,7 +409,9 @@ def _invoke(op, sym_args, params, name=None):
         needed = list(op.arg_names) + list(op.aux_names)
         for i in range(len(inputs), len(needed)):
             argname = needed[i]
-            if argname == "bias" and params.get("no_bias", False):
+            no_bias = params.get(
+                "no_bias", op.param_defaults.get("no_bias", False))
+            if argname == "bias" and no_bias:
                 continue
             is_aux = i >= len(op.arg_names)
             attrs = {"__is_aux__": "1"} if is_aux else {}

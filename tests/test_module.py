@@ -1,0 +1,144 @@
+"""Module API + end-to-end training tests
+(ref: tests/python/unittest/test_module.py, tests/python/train/
+test_mlp.py — the integration/convergence net)."""
+import logging
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, sym
+
+
+def _mlp_symbol(num_hidden=32, num_classes=4):
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=num_hidden, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(net, num_hidden=num_classes, name="fc2")
+    return sym.SoftmaxOutput(net, sym.Variable("softmax_label"),
+                             name="softmax")
+
+
+def _toy_dataset(n=400, dim=20, classes=4, seed=0):
+    rs = np.random.RandomState(seed)
+    centers = rs.randn(classes, dim).astype("float32") * 3
+    labels = rs.randint(0, classes, n)
+    data = centers[labels] + rs.randn(n, dim).astype("float32")
+    return data.astype("float32"), labels.astype("float32")
+
+
+def test_module_bind_shapes():
+    net = _mlp_symbol()
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 20))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params()
+    assert mod.binded and mod.params_initialized
+    arg, aux = mod.get_params()
+    assert arg["fc1_weight"].shape == (32, 20)
+
+
+def test_module_fit_converges():
+    data, labels = _toy_dataset()
+    train_iter = mx.io.NDArrayIter(data, labels, batch_size=40,
+                                   shuffle=True)
+    val_iter = mx.io.NDArrayIter(data, labels, batch_size=40)
+    net = _mlp_symbol()
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mx.random.seed(0)
+    mod.fit(train_iter, eval_data=val_iter, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.init.Xavier(), num_epoch=5,
+            eval_metric="acc")
+    score = mod.score(val_iter, "acc")
+    assert score[0][1] > 0.9, f"accuracy too low: {score}"
+
+
+def test_module_fit_adam_kvstore_local():
+    data, labels = _toy_dataset(seed=1)
+    train_iter = mx.io.NDArrayIter(data, labels, batch_size=50,
+                                   shuffle=True)
+    net = _mlp_symbol()
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(train_iter, optimizer="adam", kvstore="local",
+            optimizer_params={"learning_rate": 0.01},
+            initializer=mx.init.Xavier(), num_epoch=4)
+    score = mod.score(mx.io.NDArrayIter(data, labels, batch_size=50),
+                      "acc")
+    assert score[0][1] > 0.9
+
+
+def test_module_predict_and_outputs():
+    data, labels = _toy_dataset(n=60)
+    it = mx.io.NDArrayIter(data, labels, batch_size=20)
+    net = _mlp_symbol()
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data,
+             label_shapes=it.provide_label)
+    mod.init_params()
+    out = mod.predict(it)
+    assert out.shape == (60, 4)
+    np.testing.assert_allclose(out.asnumpy().sum(-1), np.ones(60),
+                               rtol=1e-4)
+
+
+def test_module_checkpoint_roundtrip(tmp_path):
+    data, labels = _toy_dataset(n=80)
+    it = mx.io.NDArrayIter(data, labels, batch_size=16)
+    net = _mlp_symbol()
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.init.Xavier())
+    prefix = str(tmp_path / "mlp")
+    mod.save_checkpoint(prefix, 3)
+    loaded_sym, arg, aux = mx.load_checkpoint(prefix, 3)
+    assert loaded_sym.list_arguments() == net.list_arguments()
+    orig, _ = mod.get_params()
+    np.testing.assert_allclose(arg["fc1_weight"].asnumpy(),
+                               orig["fc1_weight"].asnumpy())
+    # load into a fresh module and verify outputs match
+    mod2 = mx.mod.Module(loaded_sym, context=mx.cpu())
+    mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod2.init_params(arg_params=arg, aux_params=aux, force_init=True)
+    it.reset()
+    batch = it.next()
+    mod.forward(batch, is_train=False)
+    mod2.forward(batch, is_train=False)
+    np.testing.assert_allclose(mod.get_outputs()[0].asnumpy(),
+                               mod2.get_outputs()[0].asnumpy(),
+                               rtol=1e-5)
+
+
+def test_module_score_and_speedometer(caplog):
+    data, labels = _toy_dataset(n=100)
+    it = mx.io.NDArrayIter(data, labels, batch_size=25)
+    net = _mlp_symbol()
+    mod = mx.mod.Module(net, context=mx.cpu())
+    cb = mx.callback.Speedometer(25, frequent=2)
+    with caplog.at_level(logging.INFO):
+        mod.fit(it, num_epoch=1, batch_end_callback=cb,
+                initializer=mx.init.Xavier())
+    res = mod.score(it, ["acc", "ce"])
+    names = [r[0] for r in res]
+    assert "accuracy" in names and "cross-entropy" in names
+
+
+def test_fixed_params():
+    net = _mlp_symbol()
+    data, labels = _toy_dataset(n=40)
+    it = mx.io.NDArrayIter(data, labels, batch_size=20)
+    mod = mx.mod.Module(net, context=mx.cpu(),
+                        fixed_param_names=["fc1_weight"])
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.init.Xavier())
+    w_before = mod.get_params()[0]["fc1_weight"].asnumpy().copy()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    batch = it.next()
+    mod.forward_backward(batch)
+    mod.update()
+    w_after = mod.get_params()[0]["fc1_weight"].asnumpy()
+    np.testing.assert_allclose(w_before, w_after)  # frozen
+    # but fc2 moved
+    assert not np.allclose(
+        mod.get_params()[0]["fc2_weight"].asnumpy().sum(), 0)

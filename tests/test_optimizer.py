@@ -1,0 +1,121 @@
+"""Optimizer tests (ref: tests/python/unittest/test_optimizer.py)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, optimizer
+
+
+def _quadratic_converges(opt, steps=120, tol=0.05):
+    """All optimizers should minimize ||w||^2 from a fixed start."""
+    w = nd.array(np.linspace(-1, 1, 8).astype("float32"))
+    updater = optimizer.get_updater(opt)
+    for _ in range(steps):
+        grad = 2 * w
+        updater(0, grad, w)
+    return float(w.norm().asscalar())
+
+
+@pytest.mark.parametrize("name,kwargs", [
+    ("sgd", {"learning_rate": 0.1}),
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("nag", {"learning_rate": 0.05, "momentum": 0.9}),
+    ("adam", {"learning_rate": 0.05}),
+    ("adagrad", {"learning_rate": 0.3}),
+    ("rmsprop", {"learning_rate": 0.02}),
+    ("rmsprop", {"learning_rate": 0.02, "centered": True}),
+    ("adadelta", {"rho": 0.9}),
+    ("ftrl", {"learning_rate": 0.5}),
+    ("adamax", {"learning_rate": 0.1}),
+    ("nadam", {"learning_rate": 0.05}),
+    ("signum", {"learning_rate": 0.01}),
+])
+def test_optimizer_convergence(name, kwargs):
+    opt = optimizer.create(name, **kwargs)
+    final = _quadratic_converges(opt)
+    assert final < 0.25, f"{name} failed to converge: |w|={final}"
+
+
+def test_sgd_matches_manual():
+    w0 = np.array([1.0, -2.0, 3.0], "float32")
+    g = np.array([0.5, 0.5, 0.5], "float32")
+    w = nd.array(w0)
+    opt = optimizer.create("sgd", learning_rate=0.1, wd=0.0)
+    updater = optimizer.get_updater(opt)
+    updater("x_weight", nd.array(g), w)
+    np.testing.assert_allclose(w.asnumpy(), w0 - 0.1 * g, rtol=1e-6)
+
+
+def test_weight_decay_and_clip():
+    w = nd.array(np.ones(4, "float32"))
+    opt = optimizer.create("sgd", learning_rate=1.0, wd=0.1,
+                           clip_gradient=0.05)
+    updater = optimizer.get_updater(opt)
+    updater("x_weight", nd.array(np.full(4, 10.0, "float32")), w)
+    # grad clipped to 0.05, wd adds 0.1*1
+    np.testing.assert_allclose(w.asnumpy(),
+                               np.ones(4) - 1.0 * (0.05 + 0.1),
+                               rtol=1e-5)
+
+
+def test_bias_no_decay_default():
+    opt = optimizer.create("sgd", learning_rate=1.0, wd=0.5,
+                           param_idx2name={0: "fc_bias"})
+    assert opt._get_wd(0) == 0.0
+    opt2 = optimizer.create("sgd", learning_rate=1.0, wd=0.5,
+                            param_idx2name={0: "fc_weight"})
+    assert opt2._get_wd(0) == 0.5
+
+
+def test_multi_precision_sgd():
+    w = nd.array(np.ones(4), dtype="float16")
+    opt = optimizer.create("sgd", learning_rate=0.1, momentum=0.9,
+                           multi_precision=True)
+    updater = optimizer.get_updater(opt)
+    for _ in range(3):
+        updater(0, nd.array(np.ones(4), dtype="float16"), w)
+    assert w.dtype == np.float16
+    state = updater.states[0]
+    assert isinstance(state, tuple) and state[1].dtype == np.float32
+
+
+def test_lr_scheduler_factor():
+    sched = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5,
+                                            base_lr=1.0)
+    lrs = [sched(i) for i in [1, 11, 21, 31]]
+    np.testing.assert_allclose(lrs, [1.0, 0.5, 0.25, 0.125])
+
+
+def test_lr_scheduler_multifactor():
+    sched = mx.lr_scheduler.MultiFactorScheduler([5, 15], factor=0.1,
+                                                 base_lr=1.0)
+    assert sched(2) == 1.0
+    assert abs(sched(10) - 0.1) < 1e-9
+    assert abs(sched(20) - 0.01) < 1e-9
+
+
+def test_lr_in_optimizer_updates():
+    opt = optimizer.create(
+        "sgd", learning_rate=1.0,
+        lr_scheduler=mx.lr_scheduler.FactorScheduler(1, 0.5))
+    w = nd.array(np.zeros(1, "float32"))
+    updater = optimizer.get_updater(opt)
+    updater(0, nd.array(np.ones(1, "float32")), w)
+    v1 = float(w.asscalar())
+    updater(0, nd.array(np.ones(1, "float32")), w)
+    assert abs(w.asscalar() - v1) < abs(v1)  # lr decayed
+
+
+def test_updater_state_serialization():
+    opt = optimizer.create("adam", learning_rate=0.1)
+    w = nd.array(np.ones(3, "float32"))
+    updater = optimizer.get_updater(opt)
+    updater(0, nd.array(np.ones(3, "float32")), w)
+    blob = updater.get_states()
+    u2 = optimizer.get_updater(optimizer.create("adam",
+                                                learning_rate=0.1))
+    u2.set_states(blob)
+    m1, v1 = updater.states[0]
+    m2, v2 = u2.states[0]
+    np.testing.assert_allclose(m1.asnumpy(), m2.asnumpy())
+    np.testing.assert_allclose(v1.asnumpy(), v2.asnumpy())
