@@ -44,11 +44,12 @@ from . import module
 from . import module as mod
 from .module import Module
 from .io import DataBatch, DataDesc, DataIter, NDArrayIter
+from . import gluon
 
 __all__ = ["nd", "ndarray", "autograd", "Context", "cpu", "tpu", "gpu",
            "random", "NDArray", "TShape", "sym", "symbol", "Symbol",
            "Executor", "io", "initializer", "init", "optimizer",
            "lr_scheduler", "metric", "callback", "kvstore", "model",
-           "module", "mod", "Module", "DataBatch", "DataDesc",
+           "module", "mod", "Module", "gluon", "DataBatch", "DataDesc",
            "DataIter", "NDArrayIter", "load_checkpoint",
            "save_checkpoint", "__version__"]

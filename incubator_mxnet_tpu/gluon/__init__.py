@@ -1,0 +1,14 @@
+"""Gluon: the imperative frontend (ref: python/mxnet/gluon/)."""
+from .parameter import Parameter, ParameterDict
+from .block import Block, HybridBlock, SymbolBlock
+from .trainer import Trainer
+from . import nn
+from . import loss
+from . import data
+from . import utils
+from . import rnn
+from . import model_zoo
+
+__all__ = ["Parameter", "ParameterDict", "Block", "HybridBlock",
+           "SymbolBlock", "Trainer", "nn", "loss", "data", "utils",
+           "rnn", "model_zoo"]

@@ -1,0 +1,388 @@
+"""Gluon Block / HybridBlock (ref: python/mxnet/gluon/block.py —
+Block:121, HybridBlock:306, _build_cache:365, hybridize:428;
+C++ CachedOp ref: src/imperative/cached_op.cc).
+
+TPU-native hybridize: instead of building an nnvm graph and replaying
+engine pushes, `hybridize()` wraps the block's forward in `jax.jit`.
+The trace runs the exact same NDArray code with tracers inside;
+XLA compiles the whole block (fusion + memory planning), and the
+shape/dtype-keyed jit cache plays the role of CachedOp's signature
+cache (cached_op.cc:171).  Gradients flow by recording one tape node
+whose vjp is the jitted function's vjp.  Aux-state (BatchNorm moving
+stats) round-trips functionally: param values go in, updated values
+for grad_req='null' params come out and are written back.
+"""
+import re
+import threading
+
+import jax
+
+from .. import autograd, random_state
+from ..autograd import TapeNode
+from ..context import default_context
+from ..ndarray.ndarray import NDArray
+from .parameter import (DeferredInitializationError, Parameter,
+                        ParameterDict)
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock"]
+
+
+class _BlockScope:
+    """Name scoping for nested blocks (ref: block.py _BlockScope)."""
+
+    _current = threading.local()
+
+    def __init__(self, block):
+        self._block = block
+        self._counter = {}
+        self._old_scope = None
+
+    _global_counter = {}
+
+    @staticmethod
+    def create(prefix, params, hint):
+        current = getattr(_BlockScope._current, "value", None)
+        if current is None:
+            if prefix is None:
+                count = _BlockScope._global_counter.get(hint, 0)
+                _BlockScope._global_counter[hint] = count + 1
+                prefix = f"{hint}{count}_"
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, shared=params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            current._counter[hint] = count + 1
+            prefix = f"{hint}{count}_"
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix, shared=None)
+        else:
+            params = ParameterDict(params.prefix, shared=params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        self._old_scope = getattr(_BlockScope._current, "value", None)
+        _BlockScope._current.value = self
+        return self
+
+    def __exit__(self, *exc):
+        _BlockScope._current.value = self._old_scope
+
+
+class Block:
+    """Base building block (ref: gluon/block.py Block:121)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(
+            prefix, params, self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") \
+            else self._prefix
+        self._scope = _BlockScope(self)
+        self._children = []
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    def name_scope(self):
+        return self._scope
+
+    @property
+    def params(self):
+        return self._params
+
+    def collect_params(self, select=None):
+        """All parameters of self + descendants
+        (ref: block.py collect_params)."""
+        ret = ParameterDict(self._params.prefix)
+        if select is None:
+            ret.update(self.params)
+        else:
+            pattern = re.compile(select)
+            ret.update({k: v for k, v in self.params.items()
+                        if pattern.match(k)})
+        for child in self._children:
+            ret.update(child.collect_params(select))
+        return ret
+
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            existing = getattr(self, name, None)
+            if isinstance(existing, Block):
+                self._children[self._children.index(existing)] = value
+            else:
+                self._children.append(value)
+        super().__setattr__(name, value)
+
+    def register_child(self, block):
+        self._children.append(block)
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        self.collect_params().initialize(init, ctx, verbose,
+                                         force_reinit)
+
+    def cast(self, dtype):
+        for child in self._children:
+            child.cast(dtype)
+        for _, p in self.params.items():
+            p.cast(dtype)
+
+    def save_params(self, filename):
+        self.collect_params().save(filename, strip_prefix=self.prefix)
+
+    def load_params(self, filename, ctx=None, allow_missing=False,
+                    ignore_extra=False):
+        self.collect_params().load(filename, ctx, allow_missing,
+                                   ignore_extra,
+                                   restore_prefix=self.prefix)
+
+    save_parameters = save_params
+    load_parameters = load_params
+
+    def hybridize(self, active=True):
+        for child in self._children:
+            child.hybridize(active)
+
+    def __call__(self, *args):
+        return self.forward(*args)
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{self.__class__.__name__}({self._name})"
+
+
+class HybridBlock(Block):
+    """Block compilable into one XLA executable
+    (ref: block.py HybridBlock:306)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._active = False
+        self._cached_fn = None
+        self._param_order = None
+
+    def hybridize(self, active=True):
+        self._active = active
+        self._cached_fn = None
+        super().hybridize(active)
+
+    def cast(self, dtype):
+        self._cached_fn = None
+        super().cast(dtype)
+
+    def infer_shape(self, *args):
+        """Run a shape-only pass to finish deferred param init."""
+        self._deferred_infer(args)
+
+    def _deferred_infer(self, args):
+        # eager probe with eval_shape would need materialized params;
+        # layers override _pre_infer via their forward needing only
+        # shapes.  Default: run eagerly once params allow it.
+        pass
+
+    # ------------------------------------------------------------ call
+    def __call__(self, *args):
+        if not self._active:
+            return self.forward(*args)
+        # inside an enclosing cache trace, inputs are tracers: run the
+        # Python body directly — the outer jit already compiles us
+        for a in args:
+            if isinstance(a, NDArray) and isinstance(a._data,
+                                                     jax.core.Tracer):
+                return self.forward(*args)
+        return self._call_cached(*args)
+
+    def forward(self, *args):
+        """Eager path: hybrid_forward with nd + concrete params."""
+        from .. import nd as nd_mod
+        params = self._materialized_params(args)
+        return self.hybrid_forward(nd_mod, *args, **params)
+
+    def _materialized_params(self, args):
+        try:
+            return {self._strip(name): p.data()
+                    for name, p in self.params.items()}
+        except DeferredInitializationError:
+            self._finish_deferred(args)
+            return {self._strip(name): p.data()
+                    for name, p in self.params.items()}
+
+    def _strip(self, name):
+        return name[len(self.prefix):] if \
+            name.startswith(self.prefix) else name
+
+    def _finish_deferred(self, args):
+        """Infer deferred shapes from input shapes via layer hook."""
+        self.shape_from_input(*[a for a in args
+                                if isinstance(a, NDArray)])
+        for _, p in self.params.items():
+            if p._deferred_init is not None and p._shape_known():
+                p._finish_deferred_init(p.shape)
+
+    def shape_from_input(self, *inputs):
+        """Layers with deferred params override to set shapes."""
+        raise DeferredInitializationError(
+            f"{self.name}: parameter shapes unknown; construct with "
+            "explicit in_units/in_channels or run initialize() after "
+            "setting shapes")
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+    # ------------------------------------------------------------ cached
+    def _build_cache(self):
+        """Create the jitted callable (ref: block.py _build_cache:365)."""
+        params = self.collect_params()
+        # stable ordering for the pytree
+        names = sorted(params.keys())
+        param_objs = [params[n] for n in names]
+        trainable_idx = [i for i, p in enumerate(param_objs)
+                         if p.grad_req != "null"]
+        state_idx = [i for i, p in enumerate(param_objs)
+                     if p.grad_req == "null"]
+        block = self
+
+        def run(param_vals, input_vals, rng, training):
+            saved = [(p, p._data._data) for p in param_objs]
+            prev_rec = autograd.set_recording(False)
+            prev_train = autograd.set_training(training)
+            try:
+                for p, v in zip(param_objs, param_vals):
+                    p._data._data = v
+                with random_state.key_provider(rng):
+                    outs = block.forward(
+                        *[NDArray(v) for v in input_vals])
+                out_list = outs if isinstance(outs, (list, tuple)) \
+                    else [outs]
+                out_vals = [o._data for o in out_list]
+                state_vals = [param_objs[i]._data._data
+                              for i in state_idx]
+            finally:
+                for (p, v) in saved:
+                    p._data._data = v
+                autograd.set_recording(prev_rec)
+                autograd.set_training(prev_train)
+            return out_vals, state_vals
+
+        def fwd(param_vals, input_vals, rng, training):
+            return run(list(param_vals), list(input_vals), rng, training)
+
+        jitted = jax.jit(fwd, static_argnums=(3,))
+        return param_objs, trainable_idx, state_idx, jitted
+
+    def _call_cached(self, *args):
+        if self._cached_fn is None:
+            # settle deferred shapes: one eager forward lets each layer
+            # infer its own param shapes from its actual input (the
+            # reference's deferred-init pass, ref: block.py
+            # _deferred_infer_shape); then build the cache
+            if any(p._deferred_init is not None
+                   for _, p in self.collect_params().items()):
+                with autograd.pause():
+                    self.forward(*args)
+            self._cached_fn = self._build_cache()
+        param_objs, trainable_idx, state_idx, jitted = self._cached_fn
+        param_vals = tuple(p.data()._data for p in param_objs)
+        input_nds = [a for a in args if isinstance(a, NDArray)]
+        input_vals = tuple(a._data for a in input_nds)
+        rng = random_state.next_key()
+        training = autograd.is_training()
+        recording = autograd.is_recording()
+
+        if recording:
+            t_idx = trainable_idx
+
+            def f(tvals, ivals):
+                pvals = list(param_vals)
+                for i, v in zip(t_idx, tvals):
+                    pvals[i] = v
+                return jitted(tuple(pvals), ivals, rng, training)
+
+            (out_vals, state_vals), vjp_fn = jax.vjp(
+                f, tuple(param_vals[i] for i in t_idx), input_vals)
+        else:
+            out_vals, state_vals = jitted(param_vals, input_vals, rng,
+                                          training)
+
+        if training:
+            for i, v in zip(state_idx, state_vals):
+                param_objs[i]._data._data = v
+
+        out_arrays = [NDArray(v) for v in out_vals]
+        if recording:
+            import numpy as np
+
+            def node_vjp(out_cts):
+                cts = list(out_cts) if isinstance(out_cts, tuple) \
+                    else [out_cts]
+                state_cts = [
+                    (np.zeros(v.shape, jax.dtypes.float0)
+                     if not jax.numpy.issubdtype(v.dtype,
+                                                 jax.numpy.floating)
+                     else jax.numpy.zeros(v.shape, v.dtype))
+                    for v in state_vals]
+                tcts, icts = vjp_fn((cts, state_cts))
+                return list(tcts) + list(icts)
+
+            node_inputs = [param_objs[i]._data for i in trainable_idx] \
+                + input_nds
+            avals = [(tuple(v.shape), v.dtype) for v in out_vals]
+            node = TapeNode(node_vjp, node_inputs, avals,
+                            f"CachedOp({self.name})")
+            for i, arr in enumerate(out_arrays):
+                arr._autograd = (node, i)
+        if len(out_arrays) == 1:
+            return out_arrays[0]
+        return out_arrays
+
+
+class SymbolBlock(HybridBlock):
+    """Wrap a Symbol + params as a Block (ref: block.py SymbolBlock)."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix="", params=None)
+        from ..symbol.symbol import Symbol, Group
+        if isinstance(outputs, (list, tuple)):
+            outputs = Group(outputs)
+        self._symbol = outputs
+        self._inputs = inputs if isinstance(inputs, (list, tuple)) \
+            else [inputs]
+        input_names = {i.name for i in self._inputs}
+        for name in outputs.list_inputs():
+            if name not in input_names:
+                self._params.get(
+                    name, allow_deferred_init=True, grad_req="write")
+        if params is not None:
+            for name, v in params.items():
+                if name in self._params.keys():
+                    self._params[name].set_data(v)
+
+    def forward(self, *args):
+        from ..executor import build_graph_fn
+        arg_vals = {}
+        for i, a in zip(self._inputs, args):
+            arg_vals[i.name] = a._data
+        for name, p in self.params.items():
+            arg_vals[name] = p.data()._data
+        run = build_graph_fn(self._symbol)
+        outs, _ = run(arg_vals, {}, random_state.next_key(),
+                      autograd.is_training())
+        outs = [NDArray(o) for o in outs]
+        return outs[0] if len(outs) == 1 else outs
+
+    def hybrid_forward(self, F, *args, **kwargs):
+        raise NotImplementedError

@@ -1,0 +1,68 @@
+"""DataLoader (ref: python/mxnet/gluon/data/dataloader.py:23-73).
+
+The reference forks worker processes passing batches back through
+POSIX shared memory (CPUSharedStorageManager).  On TPU the bottleneck
+is the host->HBM transfer, not Python-side collation, so workers are
+threads (no pickling, zero-copy into the jnp.asarray staging call) —
+with num_workers=0 meaning synchronous loading, like the reference.
+"""
+import concurrent.futures as _futures
+
+import numpy as np
+
+from ...ndarray import array as nd_array
+from ...ndarray.ndarray import NDArray
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (ref: dataloader.py default_batchify)."""
+    if isinstance(data[0], NDArray):
+        return nd_array(np.stack([d.asnumpy() for d in data]))
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(list(i)) for i in data]
+    data = np.asarray(data)
+    return nd_array(data)
+
+
+class DataLoader:
+    """(ref: dataloader.py DataLoader)"""
+
+    def __init__(self, dataset, batch_size=None, shuffle=False,
+                 sampler=None, last_batch=None, batch_sampler=None,
+                 batchify_fn=None, num_workers=0):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError(
+                    "batch_size required unless batch_sampler given")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle \
+                    else SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle and sampler are exclusive")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._num_workers = num_workers
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for batch in self._batch_sampler:
+                yield self._batchify_fn(
+                    [self._dataset[i] for i in batch])
+            return
+        with _futures.ThreadPoolExecutor(self._num_workers) as pool:
+            futures = [
+                pool.submit(lambda idxs=batch: self._batchify_fn(
+                    [self._dataset[i] for i in idxs]))
+                for batch in self._batch_sampler]
+            for f in futures:
+                yield f.result()
+
+    def __len__(self):
+        return len(self._batch_sampler)

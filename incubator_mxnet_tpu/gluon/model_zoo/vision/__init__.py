@@ -1,0 +1,36 @@
+"""Model zoo vision models (ref: python/mxnet/gluon/model_zoo/vision/).
+"""
+from .resnet import *    # noqa: F401,F403
+from .alexnet import *   # noqa: F401,F403
+from .vgg import *       # noqa: F401,F403
+from .others import *    # noqa: F401,F403
+
+from .resnet import __all__ as _r
+from .alexnet import __all__ as _a
+from .vgg import __all__ as _v
+from .others import __all__ as _o
+
+__all__ = list(_r) + list(_a) + list(_v) + list(_o) + ["get_model"]
+
+_models = {}
+
+
+def _collect():
+    import sys
+    mod = sys.modules[__name__]
+    for name in __all__:
+        f = getattr(mod, name, None)
+        if callable(f) and name[0].islower():
+            _models[name] = f
+
+
+_collect()
+
+
+def get_model(name, **kwargs):
+    """Get a model by name (ref: model_zoo/__init__.py get_model)."""
+    name = name.lower()
+    if name not in _models:
+        raise ValueError(
+            f"unknown model '{name}'; available: {sorted(_models)}")
+    return _models[name](**kwargs)

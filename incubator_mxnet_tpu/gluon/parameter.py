@@ -1,0 +1,269 @@
+"""Gluon Parameter / ParameterDict
+(ref: python/mxnet/gluon/parameter.py — Parameter:43 lazy init +
+per-ctx copies, _reduce:246, ParameterDict:419).
+
+TPU-native note: the reference keeps one copy of each parameter per
+GPU context; under XLA a parameter is a single (possibly sharded)
+jax.Array, so `list_data()` returns the one array and sharding is
+expressed with `jax.sharding` annotations instead of copies (see
+parallel package).
+"""
+import numpy as np
+
+from .. import autograd
+from .. import initializer as init_mod
+from ..base import np_dtype
+from ..context import default_context
+from ..initializer import InitDesc
+from ..ndarray import zeros as nd_zeros
+from ..ndarray.ndarray import NDArray
+from ..symbol.symbol import Variable
+
+__all__ = ["Parameter", "ParameterDict", "DeferredInitializationError"]
+
+
+class DeferredInitializationError(RuntimeError):
+    """Parameter accessed before its shape was known."""
+
+
+class Parameter:
+    """A weight/state tensor of a Block (ref: parameter.py:43)."""
+
+    def __init__(self, name, grad_req="write", shape=None, dtype=None,
+                 lr_mult=1.0, wd_mult=1.0, init=None,
+                 allow_deferred_init=False, differentiable=True):
+        self.name = name
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = np_dtype(dtype) if dtype is not None else \
+            np.dtype("float32")
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        if not differentiable:
+            grad_req = "null"
+        self.grad_req = grad_req
+        self._data = None
+        self._grad = None
+        self._deferred_init = None
+        self._ctx = None
+
+    def __repr__(self):
+        return (f"Parameter {self.name} (shape={self.shape}, "
+                f"dtype={self.dtype})")
+
+    # ------------------------------------------------------------ init
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        if self._data is not None and not force_reinit:
+            return
+        default_init = default_init or init_mod.Uniform(0.07)
+        if isinstance(ctx, (list, tuple)):
+            ctx = ctx[0] if ctx else None
+        self._ctx = ctx or default_context()
+        if self.shape is None or any(s == 0 for s in self.shape):
+            if self.allow_deferred_init:
+                self._deferred_init = (init, default_init)
+                return
+            raise ValueError(
+                f"cannot initialize parameter {self.name} with "
+                f"unknown shape {self.shape}")
+        self._finish_init(init, default_init)
+
+    def _finish_init(self, init, default_init):
+        data = nd_zeros(self.shape, ctx=self._ctx, dtype=self.dtype)
+        initializer = init or self.init or default_init
+        initializer = init_mod.create(initializer) \
+            if isinstance(initializer, str) else initializer
+        initializer(InitDesc(self.name), data)
+        self._set_data_arr(data)
+        self._deferred_init = None
+
+    def _set_data_arr(self, data):
+        self._data = data
+        if self.grad_req != "null":
+            self._grad = nd_zeros(data.shape, ctx=self._ctx,
+                                  dtype=data.dtype)
+            autograd.mark_variables([self._data], [self._grad],
+                                    self.grad_req)
+        else:
+            self._grad = None
+
+    def _finish_deferred_init(self, shape):
+        """Called by layers once the input shape reveals ours."""
+        self.shape = tuple(shape)
+        if self._deferred_init is not None:
+            init, default_init = self._deferred_init
+            self._finish_init(init, default_init)
+
+    def _shape_known(self):
+        return self.shape is not None and all(s != 0 for s in self.shape)
+
+    # ------------------------------------------------------------ access
+    def data(self, ctx=None):
+        if self._data is None:
+            if self._deferred_init is not None:
+                raise DeferredInitializationError(
+                    f"parameter {self.name} deferred-initialized; run a "
+                    "forward pass (or set shape) first")
+            raise RuntimeError(
+                f"parameter {self.name} not initialized; call "
+                ".initialize()")
+        return self._data
+
+    def list_data(self):
+        return [self.data()]
+
+    def grad(self, ctx=None):
+        if self.grad_req == "null":
+            raise RuntimeError(f"parameter {self.name} has grad_req="
+                               "'null'")
+        if self._grad is None:
+            raise RuntimeError(f"parameter {self.name} not initialized")
+        return self._grad
+
+    def list_grad(self):
+        return [self.grad()]
+
+    def list_ctx(self):
+        return [self._ctx or default_context()]
+
+    def zero_grad(self):
+        if self._grad is not None:
+            self._grad[:] = 0
+
+    def set_data(self, data):
+        if self._data is None:
+            self.shape = tuple(data.shape)
+            self._ctx = self._ctx or default_context()
+            self._set_data_arr(data if isinstance(data, NDArray)
+                               else NDArray(data))
+        else:
+            self._data[:] = data
+
+    def reset_ctx(self, ctx):
+        if isinstance(ctx, (list, tuple)):
+            ctx = ctx[0]
+        self._ctx = ctx
+        if self._data is not None:
+            self._data._data = self._data.as_in_context(ctx)._data
+
+    def cast(self, dtype):
+        self.dtype = np_dtype(dtype)
+        if self._data is not None:
+            self._set_data_arr(self._data.astype(dtype))
+
+    def var(self):
+        """Symbol variable for this parameter (ref: parameter.py var)."""
+        return Variable(self.name, lr_mult=self.lr_mult,
+                        wd_mult=self.wd_mult)
+
+
+class ParameterDict:
+    """Ordered name->Parameter mapping with prefix + shared fallback
+    (ref: parameter.py:419)."""
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = {}
+        self._shared = shared
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def __repr__(self):
+        return f"ParameterDict({self._prefix}: {list(self._params)})"
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    def get(self, name, **kwargs):
+        """Get or create a parameter named prefix+name."""
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+        else:
+            for k, v in kwargs.items():
+                if k == "shape" and v is not None:
+                    if param.shape is None or \
+                            any(s == 0 for s in param.shape):
+                        param.shape = tuple(v)
+        return param
+
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None:
+            p = self._shared._get_impl(name)
+            if p is not None:
+                self._params[name] = p
+            return p
+        return None
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params and self._params[k] is not v:
+                raise ValueError(f"duplicate parameter {k}")
+            self._params[k] = v
+
+    # ------------------------------------------------------------ bulk ops
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        init = init or init_mod.Uniform(0.07)
+        for p in self.values():
+            p.initialize(None, ctx, init, force_reinit)
+
+    def zero_grad(self):
+        for p in self.values():
+            p.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for p in self.values():
+            p.reset_ctx(ctx)
+
+    def setattr(self, name, value):
+        for p in self.values():
+            setattr(p, name, value)
+
+    # ------------------------------------------------------------ io
+    def save(self, filename, strip_prefix=""):
+        from ..ndarray import save as nd_save
+        arg = {}
+        for p in self.values():
+            if p._data is None:
+                continue
+            name = p.name
+            if strip_prefix and name.startswith(strip_prefix):
+                name = name[len(strip_prefix):]
+            arg[name] = p.data()
+        nd_save(filename, arg)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        from ..ndarray import load as nd_load
+        loaded = nd_load(filename)
+        loaded = {restore_prefix + k: v for k, v in loaded.items()}
+        for name, p in self.items():
+            if name in loaded:
+                p.set_data(loaded[name])
+            elif not allow_missing:
+                raise IOError(f"parameter {name} missing in {filename}")
+        if not ignore_extra:
+            extra = set(loaded) - set(self._params)
+            if extra:
+                raise IOError(f"extra parameters in file: {extra}")

@@ -1,0 +1,3 @@
+"""Gluon RNN API (ref: python/mxnet/gluon/rnn/) — cells and fused
+layers arrive with the RNN milestone (lax.scan kernels)."""
+__all__ = []

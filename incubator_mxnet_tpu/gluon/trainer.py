@@ -1,0 +1,97 @@
+"""Gluon Trainer (ref: python/mxnet/gluon/trainer.py — _init_kvstore:102,
+step pushes grads / pulls weights per parameter).
+
+TPU-native: with kvstore='tpu' gradients are already mesh-reduced
+inside the compiled step (psum via sharding), so step() is just the
+optimizer application; the kvstore path is kept for API parity and
+multi-process setups.
+"""
+from .. import optimizer as opt_mod
+from ..model import _create_kvstore
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore="device", compression_params=None):
+        if isinstance(params, (dict,)) or hasattr(params, "values"):
+            params = list(params.values())
+        self._params = [p for p in params if p.grad_req != "null"]
+        self._scale = 1.0
+        optimizer_params = dict(optimizer_params or {})
+        if isinstance(optimizer, str):
+            idx2name = {i: p.name for i, p in enumerate(self._params)}
+            self._optimizer = opt_mod.create(
+                optimizer, param_idx2name=idx2name, **optimizer_params)
+        else:
+            self._optimizer = optimizer
+        for i, p in enumerate(self._params):
+            self._optimizer.set_lr_mult({p.name: p.lr_mult})
+            self._optimizer.set_wd_mult({p.name: p.wd_mult})
+        self._updater = opt_mod.get_updater(self._optimizer)
+        self._kvstore_spec = kvstore
+        self._kvstore = None
+        self._kv_initialized = False
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.lr
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def _init_kvstore(self):
+        """(ref: trainer.py:102)"""
+        arg_params = {p.name: p.data() for p in self._params}
+        kv, update_on_kvstore = _create_kvstore(
+            self._kvstore_spec, 1, arg_params)
+        self._kvstore = kv
+        self._update_on_kvstore = update_on_kvstore and kv is not None
+        if kv is not None:
+            for i, p in enumerate(self._params):
+                kv.init(i, p.data())
+            if self._update_on_kvstore:
+                kv.set_optimizer(self._optimizer)
+        self._kv_initialized = True
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """Apply one optimizer step scaled by 1/batch_size
+        (ref: trainer.py step)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        for i, p in enumerate(self._params):
+            if p._grad is None:
+                if not ignore_stale_grad:
+                    raise UserWarning(
+                        f"Gradient of Parameter `{p.name}` not set; "
+                        "call backward first, or set "
+                        "ignore_stale_grad=True")
+                continue
+            if self._kvstore is not None and self._update_on_kvstore:
+                self._kvstore.push(i, p.grad(), priority=-i)
+                self._kvstore.pull(i, out=p.data(), priority=-i)
+            elif self._kvstore is not None:
+                self._kvstore.push(i, p.grad(), priority=-i)
+                self._kvstore.pull(i, out=p.grad(), priority=-i)
+                self._updater(i, p.grad(), p.data())
+            else:
+                self._updater(i, p.grad(), p.data())
+
+    def allreduce_grads(self):
+        """Explicit grad reduction without update (API parity; on a
+        mesh the psum already happened inside the compiled step)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        self.step(batch_size, ignore_stale_grad)
+
+    def save_states(self, fname):
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states())
+
+    def load_states(self, fname):
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())

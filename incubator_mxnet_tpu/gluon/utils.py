@@ -1,0 +1,50 @@
+"""Gluon utilities (ref: python/mxnet/gluon/utils.py —
+split_data, split_and_load, clip_global_norm)."""
+import math
+
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """(ref: utils.py split_data)"""
+    size = data.shape[batch_axis]
+    if size < num_slice:
+        raise ValueError(f"batch {size} too small for {num_slice} slices")
+    if even_split and size % num_slice != 0:
+        raise ValueError(f"batch {size} not divisible by {num_slice}")
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = size if i == num_slice - 1 else (i + 1) * step
+        slices.append(data.slice_axis(batch_axis, begin, end))
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Slice a batch across contexts (ref: utils.py split_and_load).
+    On a sharded mesh prefer parallel.shard_batch which annotates one
+    global array instead of materializing slices."""
+    from ..ndarray import array as nd_array
+    if not isinstance(data, NDArray):
+        data = nd_array(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm):
+    """(ref: utils.py clip_global_norm)"""
+    total = 0.0
+    for a in arrays:
+        n = a.norm().asscalar()
+        total += float(n) ** 2
+    total = math.sqrt(total)
+    scale = max_norm / (total + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a *= scale
+    return total

@@ -140,6 +140,7 @@ class NDArrayIter(DataIter):
         self.shuffle = shuffle
         self.last_batch_handle = last_batch_handle
         self.cursor = -batch_size
+        self._carry = np.array([], dtype=np.int64)  # roll_over leftovers
         self._order = np.arange(self.num_data)
         if shuffle:
             np.random.shuffle(self._order)
@@ -160,18 +161,28 @@ class NDArrayIter(DataIter):
                          v.dtype) for k, v in self.label]
 
     def reset(self):
+        base = np.arange(self.num_data)
         if self.shuffle:
-            np.random.shuffle(self._order)
-        if self.last_batch_handle == "roll_over" and \
-                self.cursor > self.num_data:
-            self.cursor = -self.batch_size + (self.cursor % self.num_data)
+            np.random.shuffle(base)
+        if self.last_batch_handle == "roll_over":
+            # leftover samples from last epoch lead the new one
+            # (ref: io.py NDArrayIter roll_over semantics)
+            self._order = np.concatenate([self._carry, base])
+            self._carry = np.array([], dtype=np.int64)
         else:
-            self.cursor = -self.batch_size
+            self._order = base
+        self.cursor = -self.batch_size
 
     def iter_next(self):
         self.cursor += self.batch_size
         if self.last_batch_handle == "discard":
             return self.cursor + self.batch_size <= self.num_data
+        if self.last_batch_handle == "roll_over":
+            if self.cursor + self.batch_size <= len(self._order):
+                return True
+            if self.cursor < len(self._order):
+                self._carry = self._order[self.cursor:]
+            return False
         return self.cursor < self.num_data
 
     def _slice(self, arrays):
@@ -312,11 +323,15 @@ class PrefetchingIter(DataIter):
             it.reset()
         self._stop = threading.Event()
         self._queue = queue.Queue(maxsize=self._depth)
+        self._exhausted = False
         self._start()
 
     def next(self):
+        if getattr(self, "_exhausted", False):
+            raise StopIteration
         batches = self._queue.get()
         if batches is None:
+            self._exhausted = True  # worker exited; don't block again
             raise StopIteration
         data = [d for b in batches for d in b.data]
         label = [l for b in batches for l in b.label]

@@ -115,9 +115,8 @@ class KVStore:
     def barrier(self):
         import jax
         if jax.process_count() > 1:
-            # cross-host sync rides a trivial collective
-            from .parallel import host_barrier
-            host_barrier()
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("kvstore_barrier")
 
     def send_command_to_servers(self, head, body):
         pass  # no servers: command surface kept for API parity

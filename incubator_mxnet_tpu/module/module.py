@@ -102,6 +102,13 @@ class Module(BaseModule):
                 shared_module._exec.arg_dict,
                 shared_module._exec.aux_dict, allow_extra_params=True)
         self.binded = True
+        # Module.load path: apply checkpointed params on first bind
+        # (ref: module.py Module.load sets _arg_params + initialized)
+        if getattr(self, "_preloaded_params", None) is not None:
+            arg, aux = self._preloaded_params
+            self.init_params(arg_params=arg, aux_params=aux,
+                             force_init=True)
+            self._preloaded_params = None
 
     # ------------------------------------------------------------ params
     def init_params(self, initializer=None, arg_params=None,
@@ -153,10 +160,11 @@ class Module(BaseModule):
         if isinstance(optimizer, str):
             params = dict(optimizer_params or ())
             # reference default: scale summed grads by 1/batch_size
-            # (ref: module.py init_optimizer:464 rescale_grad)
+            # (ref: module.py init_optimizer:464 rescale_grad); on a
+            # multi-process mesh the global batch is num_workers larger
             if "rescale_grad" not in params and self._data_shapes:
                 batch_size = self._data_shapes[0].shape[0]
-                if kv is not None and "dist" in getattr(kv, "type", ""):
+                if kv is not None and kv.num_workers > 1:
                     batch_size *= kv.num_workers
                 params["rescale_grad"] = 1.0 / max(batch_size, 1)
             idx2name = {i: n for i, n in enumerate(self._param_names)}
@@ -175,6 +183,10 @@ class Module(BaseModule):
         if not self._update_on_kvstore:
             self._updater = opt_mod.get_updater(optimizer)
         self.optimizer_initialized = True
+        states = getattr(self, "_preload_opt_states", None)
+        if states:
+            self.load_optimizer_states(states)
+            self._preload_opt_states = None
 
     # ------------------------------------------------------------ step
     def forward(self, data_batch, is_train=None):
@@ -260,6 +272,8 @@ class Module(BaseModule):
 
     @staticmethod
     def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        """Load a checkpointed Module; params apply automatically on
+        bind() (ref: module.py Module.load)."""
         symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
         mod = Module(symbol, **kwargs)
         mod._preloaded_params = (arg_params, aux_params)
@@ -267,12 +281,6 @@ class Module(BaseModule):
             f"{prefix}-{epoch:04d}.states" if load_optimizer_states \
             else None
         return mod
-
-    def init_params_from_preloaded(self):
-        if getattr(self, "_preloaded_params", None):
-            arg, aux = self._preloaded_params
-            self.init_params(arg_params=arg, aux_params=aux,
-                             force_init=True)
 
 
 def _to_desc(d):

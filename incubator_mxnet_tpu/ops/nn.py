@@ -146,14 +146,16 @@ def pooling(data, kernel=(), pool_type="max", global_pool=False,
     window = (1, 1) + kernel
     strides = (1, 1) + stride
     pads = [(0, 0), (0, 0)] + padding
+    # NOTE: init values must be *Python scalars* so jax dispatches to
+    # the differentiable reduce_window_{max,sum} primitives
     if pool_type == "max":
         init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) \
             else jnp.iinfo(data.dtype).min
-        return jax.lax.reduce_window(data, jnp.asarray(init, data.dtype),
-                                     jax.lax.max, window, strides, pads)
+        return jax.lax.reduce_window(data, init, jax.lax.max, window,
+                                     strides, pads)
+    zero = 0.0 if jnp.issubdtype(data.dtype, jnp.floating) else 0
     summed = jax.lax.reduce_window(
-        data, jnp.asarray(0, data.dtype), jax.lax.add, window, strides,
-        pads)
+        data, zero, jax.lax.add, window, strides, pads)
     if pool_type == "sum":
         return summed
     if pool_type == "avg":
@@ -276,7 +278,7 @@ def lrn(data, nsize=5, alpha=1e-4, beta=0.75, knorm=2.0):
     pad_lo, pad_hi = (n - 1) // 2, n // 2
     window = (1, n, 1, 1)
     acc = jax.lax.reduce_window(
-        sq, jnp.asarray(0, data.dtype), jax.lax.add, window,
+        sq, 0.0, jax.lax.add, window,
         (1, 1, 1, 1), [(0, 0), (pad_lo, pad_hi), (0, 0), (0, 0)])
     return data / jnp.power(knorm + alpha / n * acc, beta)
 

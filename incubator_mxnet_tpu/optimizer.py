@@ -80,13 +80,14 @@ class Optimizer:
 
     def _get_wd(self, index):
         name = self.idx2name.get(index, index)
-        wd = self.wd * self.wd_mult.get(name, 1.0)
-        if isinstance(name, str) and (
-                name.endswith("_bias") or name.endswith("_gamma")
-                or name.endswith("_beta")):
-            # match the reference's default of not decaying bias/bn
-            wd = self.wd * self.wd_mult.get(name, 0.0)
-        return wd
+        # reference rule (ref: optimizer.py set_wd_mult): names NOT
+        # ending in _weight or _gamma default to wd_mult=0
+        if isinstance(name, str) and not (
+                name.endswith("_weight") or name.endswith("_gamma")):
+            default_mult = 0.0
+        else:
+            default_mult = 1.0
+        return self.wd * self.wd_mult.get(name, default_mult)
 
     def set_learning_rate(self, lr):
         self.lr = lr

@@ -197,3 +197,36 @@ def test_kvstore_updater_path():
 def test_kvstore_dist_async_rejected():
     with pytest.raises(ValueError):
         mx.kvstore.create("dist_async")
+
+
+def test_ndarray_iter_roll_over():
+    data = np.arange(20, dtype="float32").reshape(10, 2)
+    it = mx.io.NDArrayIter(data, None, batch_size=4,
+                           last_batch_handle="roll_over")
+    b1 = list(it)
+    assert len(b1) == 2  # partial batch held over, not emitted
+    it.reset()
+    b2 = list(it)
+    assert len(b2) == 3  # 2 carried + 10 = 12 -> 3 full batches
+    np.testing.assert_allclose(b2[0].data[0].asnumpy()[:2],
+                               data[8:10])
+
+
+def test_prefetching_iter_exhausted_raises():
+    data = np.random.rand(8, 2).astype("float32")
+    pf = mx.io.PrefetchingIter(
+        mx.io.NDArrayIter(data, None, batch_size=4))
+    assert len(list(pf)) == 2
+    import pytest as _pytest
+    with _pytest.raises(StopIteration):
+        pf.next()  # must not hang
+
+
+def test_wd_default_rule_matches_reference():
+    opt = mx.optimizer.create("sgd", wd=0.5,
+                              param_idx2name={0: "bn_gamma",
+                                              1: "fc_bias",
+                                              2: "embed0"})
+    assert opt._get_wd(0) == 0.5   # gamma IS decayed in reference
+    assert opt._get_wd(1) == 0.0   # bias exempt
+    assert opt._get_wd(2) == 0.0   # non-weight/gamma exempt

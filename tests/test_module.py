@@ -142,3 +142,25 @@ def test_fixed_params():
     # but fc2 moved
     assert not np.allclose(
         mod.get_params()[0]["fc2_weight"].asnumpy().sum(), 0)
+
+
+def test_module_load_applies_params(tmp_path):
+    data, labels = _toy_dataset(n=40)
+    it = mx.io.NDArrayIter(data, labels, batch_size=20)
+    net = _mlp_symbol()
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.init.Xavier())
+    prefix = str(tmp_path / "m")
+    mod.save_checkpoint(prefix, 1)
+    loaded = mx.mod.Module.load(prefix, 1)
+    loaded.bind(data_shapes=it.provide_data,
+                label_shapes=it.provide_label)
+    # params must already be applied (no init_params call needed)
+    it.reset()
+    batch = it.next()
+    mod.forward(batch, is_train=False)
+    loaded.forward(batch, is_train=False)
+    np.testing.assert_allclose(mod.get_outputs()[0].asnumpy(),
+                               loaded.get_outputs()[0].asnumpy(),
+                               rtol=1e-5)
