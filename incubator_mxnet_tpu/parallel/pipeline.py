@@ -1,0 +1,103 @@
+"""Pipeline parallelism over the 'pp' mesh axis (GPipe-style).
+
+The reference has no true pipeline parallelism — only manual per-layer
+device placement with cross-device copies (ref:
+src/executor/graph_executor.cc PlaceDevice :337-411,
+example/model-parallel-lstm) and engine-level compute/comm overlap.
+This module is the designed-for-TPU replacement: homogeneous stages
+laid out over the 'pp' mesh axis, microbatches streamed through a
+`lax.scan` whose per-step activation hand-off is a
+`lax.ppermute` to the next stage — the canonical scan-pipeline
+formulation (cf. the scaling-book pipelining recipe).  Differentiable
+end-to-end, so `jax.grad` of a pipelined loss yields the 1F1B-ish
+interleaved backward automatically.
+
+Stages must be homogeneous: one `stage_fn(stage_params, x) -> y` with
+x and y of identical shape (e.g. transformer blocks).  First/last
+stages that differ (embedding, head) run outside the pipelined region.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+__all__ = ["pipeline_apply", "stack_stage_params"]
+
+
+def stack_stage_params(per_stage_params):
+    """Stack a list of per-stage param pytrees along a new leading
+    stage axis (to be sharded over 'pp')."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs, axis=0), *per_stage_params)
+
+
+def _pp_body(stage_fn, n_stages, n_micro, stage_params, x_micro):
+    """Per-device body under shard_map: run the microbatch schedule.
+
+    x_micro: (n_micro, mb, ...) — full microbatched input, replicated
+    over 'pp' (only stage 0 reads it).  Returns (T, mb, ...) outputs
+    as produced by *this* device; the caller selects the last stage.
+    """
+    pp_idx = jax.lax.axis_index("pp")
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    total = n_micro + n_stages - 1
+
+    def body(carry, t):
+        state = carry
+        mb = jax.lax.dynamic_index_in_dim(
+            x_micro, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False)
+        inp = jnp.where(pp_idx == 0, mb, state)
+        out = stage_fn(stage_params, inp)
+        nxt = jax.lax.ppermute(out, "pp", perm)
+        return nxt, out
+
+    init = jnp.zeros_like(x_micro[0])
+    _, outs = jax.lax.scan(body, init, jnp.arange(total))
+    return outs
+
+
+def pipeline_apply(stage_fn, stacked_params, x, mesh, n_microbatches,
+                   batch_axis_name="dp"):
+    """Run x through `n_stages` pipelined stages on `mesh`'s 'pp' axis.
+
+    stacked_params: pytree with leading stage dim (see
+    stack_stage_params), laid out sharded over 'pp'.
+    x: (batch, ...) global input (sharded over 'dp' outside).
+    Returns y with x's shape.
+    """
+    n_stages = mesh.shape["pp"]
+    if n_stages == 1:
+        params = jax.tree_util.tree_map(lambda a: a[0], stacked_params)
+        return stage_fn(params, x)
+    mb_count = n_microbatches
+    b = x.shape[0]
+    if b % mb_count != 0:
+        raise ValueError(f"batch {b} not divisible by "
+                         f"{mb_count} microbatches")
+    x_micro = x.reshape((mb_count, b // mb_count) + x.shape[1:])
+
+    param_specs = jax.tree_util.tree_map(
+        lambda a: P("pp", *([None] * (a.ndim - 1))), stacked_params)
+    # shard microbatches over 'dp' too when they divide evenly;
+    # otherwise replicate the batch across 'dp' (pure-pp mode)
+    mb_size = b // mb_count
+    baxis = batch_axis_name if mb_size % mesh.shape[batch_axis_name] \
+        == 0 else None
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(param_specs,
+                  P(None, baxis, *([None] * (x_micro.ndim - 2)))),
+        out_specs=P("pp", None, baxis,
+                    *([None] * (x_micro.ndim - 2))),
+        check_vma=False)
+    def run(stacked, xm):
+        local = jax.tree_util.tree_map(lambda a: a[0], stacked)
+        outs = _pp_body(stage_fn, n_stages, mb_count, local, xm)
+        return outs[None]  # add back the 'pp' axis for out_specs
+
+    outs = run(stacked_params, x_micro)  # (pp, T, mb, ...)
+    # valid outputs: last stage, time steps [n_stages-1, total)
+    y_micro = outs[-1, n_stages - 1:]
+    return y_micro.reshape((b,) + x.shape[1:])

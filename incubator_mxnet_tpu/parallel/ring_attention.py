@@ -1,0 +1,91 @@
+"""Ring attention: sequence/context parallelism over the 'sp' axis.
+
+A new-capability design (the reference has nothing comparable — its
+only long-sequence tool is bucketing, SURVEY.md §5): the sequence axis
+is sharded over the 'sp' mesh axis; each device holds a Q block and
+rotates K/V blocks around the ring with `lax.ppermute`, accumulating
+attention with the numerically-stable blockwise (flash) recurrence
+(running max m, normalizer l, weighted sum o).  Compute on the current
+block overlaps with the ICI transfer of the next — the classic ring
+schedule.  Differentiable: `jax.grad` through scan+ppermute yields the
+reverse ring automatically.
+
+Shapes (per device, inside shard_map over 'sp'):
+    q, k, v : (batch, seq_local, heads, head_dim)
+Causal masking uses global positions derived from axis_index('sp').
+"""
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["ring_attention_local", "ring_attention"]
+
+
+def ring_attention_local(q, k, v, axis_name="sp", causal=False,
+                         scale=None):
+    """Ring attention body — call inside shard_map over `axis_name`."""
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    q = q * scale
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    neg = jnp.asarray(jnp.finfo(q.dtype).min, q.dtype)
+
+    q_pos = idx * lq + jnp.arange(lq)  # global positions of our Q rows
+
+    def body(carry, step):
+        k_blk, v_blk, m, l, o = carry
+        # which shard does this K/V block come from? it has been
+        # ppermute'd `step` times, so it originated at idx - step
+        src = (idx - step) % n
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk)
+        if causal:
+            k_pos = src * lk + jnp.arange(lk)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            scores = jnp.where(mask[None, None], scores, neg)
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
+        # guard fully-masked rows (m_new == neg) against inf/nan
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(scores - m_safe[..., None])
+        if causal:
+            p = jnp.where(mask[None, None], p, 0.0)
+        corr = jnp.exp(jnp.where(jnp.isfinite(m), m - m_safe, neg))
+        l_new = corr * l + jnp.sum(p, axis=-1)
+        o_new = corr[..., None] * o + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_blk)
+        k_nxt = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (k_nxt, v_nxt, m_new, l_new, o_new), None
+
+    m0 = jnp.full((b, h, lq), neg, q.dtype)
+    l0 = jnp.zeros((b, h, lq), q.dtype)
+    o0 = jnp.zeros((b, h, lq, d), q.dtype)
+    (_, _, _, l, o), _ = jax.lax.scan(
+        body, (k, v, m0, l0, o0), jnp.arange(n))
+    out = o / jnp.maximum(l, 1e-20)[..., None]
+    return jnp.transpose(out, (0, 2, 1, 3))  # (B, Lq, H, D)
+
+
+def ring_attention(q, k, v, mesh, causal=False, scale=None,
+                   batch_axis="dp", seq_axis="sp"):
+    """shard_map wrapper: q/k/v are global (B, L, H, D) arrays laid
+    out with B over `batch_axis` and L over `seq_axis`."""
+    if batch_axis is not None and \
+            q.shape[0] % mesh.shape[batch_axis] != 0:
+        batch_axis = None  # batch too small to split: replicate
+    spec = P(batch_axis, seq_axis, None, None)
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    def run(ql, kl, vl):
+        return ring_attention_local(ql, kl, vl, axis_name=seq_axis,
+                                    causal=causal, scale=scale)
+
+    return run(q, k, v)

@@ -1,0 +1,82 @@
+"""Parameter sharding rules: regex -> PartitionSpec.
+
+The reference expresses model parallelism as per-node device groups
+(`__ctx_group__` + PlaceDevice inserting _CrossDeviceCopy, ref:
+src/executor/graph_executor.cc:337-411).  The TPU-native form is
+declarative: a table of (parameter-name regex -> PartitionSpec) that
+annotates how each weight is laid out over the mesh; XLA then derives
+the collectives.  Defaults implement Megatron-style tensor parallelism
+for Dense/Conv pairs:
+
+- column-parallel matmul: shard the output-features dim over 'tp'
+  (activations become tp-sharded, no collective needed going in);
+- row-parallel matmul: shard the input-features dim over 'tp'
+  (XLA inserts the psum on the way out);
+- embeddings: shard the vocab dim over 'tp';
+- everything else (biases, norm scales): replicated.
+"""
+import re
+
+from jax.sharding import NamedSharding, PartitionSpec
+
+__all__ = ["ShardingRules", "tp_rules_for_dense_stacks",
+            "apply_rules", "constrain"]
+
+P = PartitionSpec
+
+
+class ShardingRules:
+    """Ordered (regex, PartitionSpec) table with a replicated default."""
+
+    def __init__(self, rules=None, default=P()):
+        self.rules = [(re.compile(pat), spec)
+                      for pat, spec in (rules or [])]
+        self.default = default
+
+    def spec_for(self, name, ndim=None):
+        """Spec for `name`; if ndim is given, specs longer than the
+        array rank fall back to replicated rather than failing deep
+        inside jax."""
+        for pat, spec in self.rules:
+            if pat.search(name):
+                if ndim is not None and len(spec) > ndim:
+                    return self.default
+                return spec
+        return self.default
+
+    def shardings(self, mesh, params):
+        """Dict of NamedShardings matching a params dict pytree."""
+        return {n: NamedSharding(mesh, self.spec_for(n, v.ndim))
+                for n, v in params.items()}
+
+
+def tp_rules_for_dense_stacks():
+    """Default Megatron-ish rules for blocks built from Dense layers
+    named `*_up_*`/`*_down_*` (or `*col*`/`*row*`): up/col projections
+    are column-parallel, down/row projections row-parallel.
+
+    Dense weight layout in this framework is (out_features,
+    in_features) — the reference FullyConnected convention
+    (ref: src/operator/fully_connected-inl.h weight shape).
+    """
+    return ShardingRules([
+        (r"(_up_|col|qkv|gate)\w*weight$", P("tp", None)),
+        (r"(_down_|row|proj_o|out_proj)\w*weight$", P(None, "tp")),
+        (r"(_up_|col|qkv|gate)\w*bias$", P("tp")),
+        (r"embedding\w*weight$", P("tp", None)),
+    ])
+
+
+def apply_rules(mesh, params, rules):
+    """Device-put each param with its rule's NamedSharding."""
+    import jax
+    shardings = rules.shardings(mesh, params)
+    return {n: jax.device_put(v, shardings[n])
+            for n, v in params.items()}
+
+
+def constrain(x, mesh, *spec):
+    """In-trace sharding constraint (activation annotation)."""
+    import jax
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(*spec)))
