@@ -1,0 +1,67 @@
+"""Benchmark: ResNet-50 training throughput (samples/sec) on one chip.
+
+Mirrors the reference's headline number — ResNet-50 ImageNet training
+throughput at batch 32 (ref: example/image-classification/README.md:
+147-156 — 109 img/s on 1x K80).  The measured step is the full
+compiled fwd+bwd+SGD-momentum update through the framework's
+ShardedTrainStep (the kvstore='tpu' path) on synthetic ImageNet-shaped
+data, which is what the reference table measured (data pipeline
+excluded; theirs used pre-decoded RecordIO on a local disk).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import time
+
+import numpy as np
+
+BASELINE_IMG_S = 109.0  # ResNet-50 batch 32, 1x K80 (BASELINE.md)
+BATCH = 32
+WARMUP_STEPS = 3
+MEASURE_STEPS = 20
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import parallel
+
+    mx.random.seed(0)
+    net = mx.gluon.model_zoo.vision.resnet50_v1()
+    net.initialize(mx.initializer.Xavier())
+
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.rand(BATCH, 3, 224, 224), jnp.float32)
+    y = jnp.asarray(rs.randint(0, 1000, (BATCH,)), jnp.int32)
+
+    step = parallel.ShardedTrainStep(
+        net, optimizer="sgd",
+        optimizer_params=dict(learning_rate=0.1, momentum=0.9,
+                              wd=1e-4),
+        mesh=parallel.make_mesh(devices=jax.devices()[:1]),
+        example_args=[x])
+
+    rng = jax.random.PRNGKey(0)
+    for _ in range(WARMUP_STEPS):
+        loss = step(x, y, rng=rng)
+    float(loss)  # sync
+
+    t0 = time.perf_counter()
+    for _ in range(MEASURE_STEPS):
+        loss = step(x, y, rng=rng)
+    final_loss = float(loss)  # sync point
+    dt = time.perf_counter() - t0
+
+    img_s = BATCH * MEASURE_STEPS / dt
+    assert np.isfinite(final_loss), final_loss
+    print(json.dumps({
+        "metric": "resnet50_train_throughput_batch32_1chip",
+        "value": round(img_s, 2),
+        "unit": "samples/sec",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -39,11 +39,14 @@ class FunctionalOptimizer:
         return self._update(params, grads, state, scale)
 
 
-def sgd(learning_rate=0.01, momentum=0.0, wd=0.0, clip_gradient=None):
+def sgd(learning_rate=0.01, momentum=0.0, wd=0.0, clip_gradient=None,
+        nesterov=False):
     """SGD(+momentum, +wd) — semantics of the reference's sgd_update /
     sgd_mom_update kernels (ref: src/operator/optimizer_op.cc:39,66):
     grad = scale*grad [clipped] + wd*weight; mom = m*mom - lr*grad;
-    weight += mom."""
+    weight += mom.  With ``nesterov=True``, NAG semantics (ref:
+    python/mxnet/optimizer.py NAG:592): mom = m*mom + grad;
+    weight -= lr*(grad + m*mom)."""
     lr, mom, wdec = learning_rate, momentum, wd
 
     def init_fn(params):
@@ -59,6 +62,9 @@ def sgd(learning_rate=0.01, momentum=0.0, wd=0.0, clip_gradient=None):
             g = g + wdec * w
             if m is None:
                 return w - lr * g, None
+            if nesterov:
+                m_new = mom * m + g
+                return w - lr * (g + mom * m_new), m_new
             m_new = mom * m - lr * g
             return w + m_new, m_new
 
@@ -114,15 +120,17 @@ def adam(learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                                dict(lr=lr, beta1=beta1, beta2=beta2))
 
 
-_REGISTRY = {"sgd": sgd, "adam": adam}
+def _nag(**kwargs):
+    return sgd(nesterov=True, **kwargs)
+
+
+_REGISTRY = {"sgd": sgd, "adam": adam, "nag": _nag}
 
 
 def create(name, **kwargs):
     if callable(name):
         return name(**kwargs)
     key = name.lower()
-    if key == "nag":
-        key = "sgd"
     if key not in _REGISTRY:
         raise ValueError(
             f"no functional optimizer '{name}'; available: "

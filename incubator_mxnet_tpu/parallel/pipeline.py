@@ -67,6 +67,11 @@ def pipeline_apply(stage_fn, stacked_params, x, mesh, n_microbatches,
     Returns y with x's shape.
     """
     n_stages = mesh.shape["pp"]
+    leaves = jax.tree_util.tree_leaves(stacked_params)
+    if leaves and leaves[0].shape[0] != n_stages:
+        raise ValueError(
+            f"stacked_params has {leaves[0].shape[0]} stages but "
+            f"mesh 'pp' axis is {n_stages}")
     if n_stages == 1:
         params = jax.tree_util.tree_map(lambda a: a[0], stacked_params)
         return stage_fn(params, x)

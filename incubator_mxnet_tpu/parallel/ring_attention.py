@@ -35,7 +35,8 @@ def ring_attention_local(q, k, v, axis_name="sp", causal=False,
         scale = 1.0 / math.sqrt(d)
     q = q * scale
     perm = [(i, (i + 1) % n) for i in range(n)]
-    neg = jnp.asarray(jnp.finfo(q.dtype).min, q.dtype)
+    neg = jnp.asarray(-jnp.inf, q.dtype)  # -inf so the isfinite
+    # guards below actually fire for fully-masked causal rows
 
     q_pos = idx * lq + jnp.arange(lq)  # global positions of our Q rows
 

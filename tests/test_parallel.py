@@ -61,6 +61,36 @@ def test_functional_sgd_matches_imperative():
     np.testing.assert_allclose(np.asarray(p2["w"]), wn, rtol=1e-5)
 
 
+def test_functional_nag_matches_imperative():
+    rs = np.random.RandomState(11)
+    w0 = rs.rand(4, 3).astype(np.float32)
+    g = rs.rand(4, 3).astype(np.float32)
+    opt = foptim.create("nag", learning_rate=0.1, momentum=0.9,
+                        wd=0.01)
+    p = {"w": jnp.asarray(w0)}
+    s = opt.init(p)
+    for _ in range(3):
+        p, s = opt.update(p, {"w": jnp.asarray(g)}, s)
+    iopt = mx.optimizer.create("nag", learning_rate=0.1, momentum=0.9,
+                               wd=0.01)
+    wi = mx.nd.array(w0)
+    st = iopt.create_state(0, wi)
+    for _ in range(3):
+        iopt.update(0, wi, mx.nd.array(g), st)
+    np.testing.assert_allclose(np.asarray(p["w"]), wi.asnumpy(),
+                               rtol=1e-5)
+
+
+def test_pipeline_stage_count_mismatch_raises():
+    mesh = parallel.make_mesh(pp=2)
+    stacked = parallel.stack_stage_params(
+        [{"w": jnp.zeros((3, 3))} for _ in range(4)])
+    with pytest.raises(ValueError, match="stages"):
+        parallel.pipeline_apply(lambda p, x: x, stacked,
+                                jnp.zeros((4, 3)), mesh,
+                                n_microbatches=2)
+
+
 def test_sharded_train_step_dp_loss_decreases():
     rs = np.random.RandomState(2)
     net = mx.gluon.nn.HybridSequential()
