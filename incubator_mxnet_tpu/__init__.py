@@ -45,6 +45,7 @@ from . import module as mod
 from .module import Module
 from .io import DataBatch, DataDesc, DataIter, NDArrayIter
 from . import gluon
+from . import rnn
 from . import parallel
 
 __all__ = ["nd", "ndarray", "autograd", "Context", "cpu", "tpu", "gpu",

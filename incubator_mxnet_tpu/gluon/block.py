@@ -58,7 +58,11 @@ class _BlockScope:
             prefix = f"{hint}{count}_"
         if params is None:
             parent = current._block.params
-            params = ParameterDict(parent.prefix + prefix, shared=None)
+            # inherit the parent's shared fallback so cells created
+            # under a scope with shared params resolve into it
+            # (ref: block.py _BlockScope.create)
+            params = ParameterDict(parent.prefix + prefix,
+                                   shared=parent._shared)
         else:
             params = ParameterDict(params.prefix, shared=params)
         return current._block.prefix + prefix, params

@@ -1,3 +1,12 @@
-"""Gluon RNN API (ref: python/mxnet/gluon/rnn/) — cells and fused
-layers arrive with the RNN milestone (lax.scan kernels)."""
-__all__ = []
+"""Gluon RNN API (ref: python/mxnet/gluon/rnn/): recurrent cells and
+fused lax.scan layers."""
+from .rnn_cell import (RecurrentCell, HybridRecurrentCell, RNNCell,
+                       LSTMCell, GRUCell, SequentialRNNCell,
+                       DropoutCell, ModifierCell, ZoneoutCell,
+                       ResidualCell, BidirectionalCell)
+from .rnn_layer import RNN, LSTM, GRU
+
+__all__ = ["RecurrentCell", "HybridRecurrentCell", "RNNCell",
+           "LSTMCell", "GRUCell", "SequentialRNNCell", "DropoutCell",
+           "ModifierCell", "ZoneoutCell", "ResidualCell",
+           "BidirectionalCell", "RNN", "LSTM", "GRU"]

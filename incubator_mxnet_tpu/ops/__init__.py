@@ -16,6 +16,7 @@ from . import nn            # noqa: F401
 from . import la            # noqa: F401
 from . import optimizer_op  # noqa: F401
 from . import random_op     # noqa: F401
+from . import rnn           # noqa: F401
 
 __all__ = ["OPS", "OpDef", "defop", "alias", "get_op", "find_op",
            "list_ops"]

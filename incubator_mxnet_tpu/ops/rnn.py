@@ -1,0 +1,181 @@
+"""Fused RNN op: multi-layer (bi)directional RNN/LSTM/GRU via lax.scan.
+
+Role analog of the reference's `RNN` op (ref: src/operator/rnn-inl.h,
+registered rnn.cc; GPU-only via cuDNN `cudnn_rnn-inl.h` — the CPU path
+was never implemented, rnn-inl.h:319 LOG(FATAL)).  This TPU-native
+version works everywhere: per-timestep input projections are hoisted
+out of the scan into one big (T*N, C) x (C, G*H) matmul that tiles
+onto the MXU; only the (N,H) x (H,G*H) recurrent matmul stays inside
+`lax.scan`.
+
+API parity with the reference op:
+  RNN(data, parameters, state[, state_cell], state_size=, num_layers=,
+      mode='rnn_relu'|'rnn_tanh'|'lstm'|'gru', bidirectional=False,
+      p=0.0, state_outputs=False)
+  data (T, N, C) time-major; parameters is the flat packed vector in
+  cuDNN order (all gate weights layer-major then all gate biases —
+  the packing gluon's rnn_layer produces); state (L*D, N, H).
+Gate order: LSTM i,f,g,o; GRU r,z,n (cuDNN convention, what the
+reference's fused kernels used).
+"""
+import jax
+import jax.numpy as jnp
+
+from .registry import defop
+
+__all__ = ["rnn"]
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def _unpack_params(flat, mode, num_layers, input_size, H, bidir):
+    """Walk the flat cuDNN-packed vector into per-(layer,dir) W/b."""
+    G = _GATES[mode]
+    D = 2 if bidir else 1
+    weights, biases = [], []
+    off = 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else H * D
+        for _ in range(D):
+            w_ih = flat[off:off + G * H * in_sz].reshape(G * H, in_sz)
+            off += G * H * in_sz
+            w_hh = flat[off:off + G * H * H].reshape(G * H, H)
+            off += G * H * H
+            weights.append((w_ih, w_hh))
+    for layer in range(num_layers):
+        for _ in range(D):
+            b_ih = flat[off:off + G * H]
+            off += G * H
+            b_hh = flat[off:off + G * H]
+            off += G * H
+            biases.append((b_ih, b_hh))
+    return weights, biases
+
+
+def rnn_param_size(mode, num_layers, input_size, state_size,
+                   bidirectional=False):
+    """Length of the flat parameter vector (helper for frontends)."""
+    G = _GATES[mode]
+    D = 2 if bidirectional else 1
+    H = state_size
+    n = 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else H * D
+        n += D * (G * H * in_sz + G * H * H + 2 * G * H)
+    return n
+
+
+def _cell_step(mode, H):
+    """Vanilla-RNN step (lstm/gru have bespoke steps in _run_layer)."""
+    act = jnp.tanh if mode == "rnn_tanh" else jax.nn.relu
+
+    def step(carry, g):
+        (h,) = carry
+        h_new = act(g)
+        return (h_new,), h_new
+    return step
+
+
+def _run_layer(x, w_ih, w_hh, b_ih, b_hh, h0, c0, mode, reverse,
+               clip=None):
+    """One direction of one layer. x (T,N,C) -> y (T,N,H), finals."""
+    if reverse:
+        x = jnp.flip(x, 0)
+    H = h0.shape[-1]
+    xg = jnp.einsum("tnc,gc->tng", x, w_ih) + b_ih  # hoisted matmul
+
+    if mode == "gru":
+        def step(carry, xg_t):
+            (h,) = carry
+            hg = h @ w_hh.T + b_hh
+            xr, xz, xn = jnp.split(xg_t, 3, axis=-1)
+            hr, hz, hn = jnp.split(hg, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            h_new = (1 - z) * n + z * h
+            return (h_new,), h_new
+        (hT,), ys = jax.lax.scan(step, (h0,), xg)
+        finals = (hT,)
+    elif mode == "lstm":
+        def step(carry, xg_t):
+            h, c = carry
+            g = xg_t + h @ w_hh.T + b_hh
+            i, f, gg, o = jnp.split(g, 4, axis=-1)
+            c_new = jax.nn.sigmoid(f) * c + \
+                jax.nn.sigmoid(i) * jnp.tanh(gg)
+            if clip is not None:
+                # per-timestep cell-state clip BEFORE the output gate,
+                # cuDNN parity (ref: rnn-inl.h lstm_state_clip_{min,max})
+                c_new = jnp.clip(c_new, clip[0], clip[1])
+            h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+            return (h_new, c_new), h_new
+        (hT, cT), ys = jax.lax.scan(step, (h0, c0), xg)
+        finals = (hT, cT)
+    else:
+        cell = _cell_step(mode, H)
+
+        def step(carry, xg_t):
+            (h,) = carry
+            g = xg_t + h @ w_hh.T + b_hh
+            return cell((h,), g)
+        (hT,), ys = jax.lax.scan(step, (h0,), xg)
+        finals = (hT,)
+    if reverse:
+        ys = jnp.flip(ys, 0)
+    return ys, finals
+
+
+def _rnn_num_outputs(params):
+    return 3 if params.get("state_outputs", False) and \
+        params.get("mode", "lstm") == "lstm" else \
+        (2 if params.get("state_outputs", False) else 1)
+
+
+@defop("RNN", variadic=True, needs_rng=True, needs_mode=True,
+       num_outputs=_rnn_num_outputs)
+def rnn(*args, state_size=0, num_layers=1, mode="lstm",
+        bidirectional=False, p=0.0, state_outputs=False,
+        lstm_state_clip_min=None, lstm_state_clip_max=None,
+        _rng=None, _training=False):
+    """Fused multi-layer RNN (ref: src/operator/rnn-inl.h RNNParam)."""
+    data, flat = args[0], args[1]
+    state = args[2]
+    state_cell = args[3] if mode == "lstm" and len(args) > 3 else None
+    T, N, C = data.shape
+    H = int(state_size)
+    L = int(num_layers)
+    D = 2 if bidirectional else 1
+    weights, biases = _unpack_params(flat, mode, L, C, H, bidirectional)
+
+    clip = (lstm_state_clip_min, lstm_state_clip_max) \
+        if lstm_state_clip_min is not None else None
+    x = data
+    h_finals, c_finals = [], []
+    for layer in range(L):
+        outs = []
+        for d in range(D):
+            idx = layer * D + d
+            w_ih, w_hh = weights[idx]
+            b_ih, b_hh = biases[idx]
+            h0 = state[idx]
+            c0 = state_cell[idx] if state_cell is not None else None
+            ys, finals = _run_layer(x, w_ih, w_hh, b_ih, b_hh, h0, c0,
+                                    mode, reverse=(d == 1), clip=clip)
+            outs.append(ys)
+            h_finals.append(finals[0])
+            if mode == "lstm":
+                c_finals.append(finals[1])
+        x = outs[0] if D == 1 else jnp.concatenate(outs, axis=-1)
+        if p > 0.0 and _training and layer < L - 1:
+            keep = 1.0 - p
+            sub = jax.random.fold_in(_rng, layer)
+            mask = jax.random.bernoulli(sub, keep, x.shape)
+            x = jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+    if not state_outputs:
+        return x
+    h_out = jnp.stack(h_finals, axis=0)
+    if mode == "lstm":
+        return x, h_out, jnp.stack(c_finals, axis=0)
+    return x, h_out

@@ -9,3 +9,28 @@ _internal = _register.populate(_sys.modules[__name__])
 
 __all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
            "NameManager"]
+
+
+def zeros(shape, dtype="float32", **kwargs):
+    """Symbolic zeros tensor (ref: python/mxnet/symbol/symbol.py zeros)."""
+    return _internal._zeros(shape=shape, dtype=dtype, **kwargs)
+
+
+def ones(shape, dtype="float32", **kwargs):
+    """Symbolic ones tensor (ref: symbol.py ones)."""
+    return _internal._ones(shape=shape, dtype=dtype, **kwargs)
+
+
+def full(shape, val, dtype="float32", **kwargs):
+    """Symbolic constant-filled tensor (ref: symbol.py full)."""
+    return _internal._full(shape=shape, value=val, dtype=dtype, **kwargs)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, dtype="float32",
+           **kwargs):
+    """Symbolic arange (ref: symbol.py arange)."""
+    return _internal._arange(start=start, stop=stop, step=step,
+                             repeat=repeat, dtype=dtype, **kwargs)
+
+
+__all__ += ["zeros", "ones", "full", "arange"]

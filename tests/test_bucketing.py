@@ -1,0 +1,161 @@
+"""BucketingModule + BucketSentenceIter end-to-end (model: reference
+tests/python/train/test_bucketing.py and example/rnn/lstm_bucketing.py
+— a bucketed LSTM language model must train and drop perplexity)."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+
+
+def _make_sentences(rs, n=200, vmin=1, vmax=20):
+    """Random 'sentences' with a learnable pattern: each token is
+    followed by (token+1) mod vocab."""
+    sents = []
+    for _ in range(n):
+        length = rs.randint(4, 17)
+        start = rs.randint(vmin, vmax)
+        sent = [(start + i) % vmax + 1 for i in range(length)]
+        sents.append(sent)
+    return sents
+
+
+def _sym_gen_factory(vocab_size, num_hidden, num_embed, batch):
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data, input_dim=vocab_size,
+                                 output_dim=num_embed, name="embed")
+        # NT -> TNC for the fused RNN op
+        tnc = mx.sym.swapaxes(embed, dim1=0, dim2=1)
+        params = mx.sym.Variable("rnn_parameters")
+        init_h = mx.sym.zeros((1, batch, num_hidden))
+        init_c = mx.sym.zeros((1, batch, num_hidden))
+        out = mx.sym.RNN(tnc, params, init_h, init_c,
+                         state_size=num_hidden, num_layers=1,
+                         mode="lstm", name="rnn")
+        ntc = mx.sym.swapaxes(out, dim1=0, dim2=1)
+        pred = mx.sym.Reshape(ntc, shape=(-1, num_hidden))
+        pred = mx.sym.FullyConnected(pred, num_hidden=vocab_size,
+                                     name="pred")
+        lab = mx.sym.Reshape(label, shape=(-1,))
+        pred = mx.sym.SoftmaxOutput(pred, lab, name="softmax")
+        return pred, ("data",), ("softmax_label",)
+    return sym_gen
+
+
+def _initializer():
+    """Fused rnn_parameters is 1-D: route it to Uniform, rest Xavier
+    (the reference used init.FusedRNN for this, ref: initializer.py
+    FusedRNN:676)."""
+    return mx.initializer.Mixed(
+        [".*rnn_parameters", ".*"],
+        [mx.initializer.Uniform(0.1), mx.initializer.Xavier()])
+
+
+def test_bucket_sentence_iter():
+    rs = np.random.RandomState(0)
+    sents = _make_sentences(rs)
+    it = mx.rnn.BucketSentenceIter(sents, batch_size=8,
+                                   buckets=[8, 12, 16],
+                                   invalid_label=0)
+    seen = set()
+    n = 0
+    for batch in it:
+        assert batch.data[0].shape == (8, batch.bucket_key)
+        assert batch.label[0].shape == (8, batch.bucket_key)
+        seen.add(batch.bucket_key)
+        n += 1
+    assert n > 0
+    assert len(seen) > 1, "expected multiple buckets"
+    it.reset()
+    assert sum(1 for _ in it) == n
+
+
+def test_bucketing_module_trains():
+    rs = np.random.RandomState(1)
+    vocab_size, num_hidden, num_embed, batch = 22, 16, 8, 8
+    sents = _make_sentences(rs)
+    it = mx.rnn.BucketSentenceIter(sents, batch_size=batch,
+                                   buckets=[8, 12, 16],
+                                   invalid_label=0)
+
+    # state/parameter shapes depend on batch: provide via shapes dict
+    sym_gen = _sym_gen_factory(vocab_size, num_hidden, num_embed,
+                               batch)
+
+    mod = mx.mod.BucketingModule(
+        sym_gen, default_bucket_key=it.default_bucket_key)
+
+    from incubator_mxnet_tpu.ops.rnn import rnn_param_size
+    psize = rnn_param_size("lstm", 1, num_embed, num_hidden)
+
+    def shapes_for(bkey, bsz):
+        return ([mx.io.DataDesc("data", (bsz, bkey)),
+                 mx.io.DataDesc("rnn_parameters", (psize,))],
+                [mx.io.DataDesc("softmax_label", (bsz, bkey))])
+
+    dsh, lsh = shapes_for(it.default_bucket_key, batch)
+    mod.bind(data_shapes=dsh, label_shapes=lsh)
+    mod.init_params(_initializer())
+    mod.init_optimizer(kvstore=None, optimizer="adam",
+                       optimizer_params=(("learning_rate", 0.01),))
+    metric = mx.metric.Perplexity(ignore_label=0)
+
+    def run_epoch():
+        metric.reset()
+        it.reset()
+        for batch_data in it:
+            dsh_b, lsh_b = shapes_for(batch_data.bucket_key, batch)
+            batch_data.provide_data = dsh_b
+            batch_data.provide_label = lsh_b
+            mod.forward(batch_data, is_train=True)
+            mod.backward()
+            mod.update()
+            mod.update_metric(metric, batch_data.label)
+        return metric.get()[1]
+
+    first = run_epoch()
+    last = None
+    for _ in range(4):
+        last = run_epoch()
+    assert last < first * 0.7, (first, last)
+
+
+def test_bucketing_param_sync_across_buckets():
+    """Updates on one bucket must be visible after switching."""
+    rs = np.random.RandomState(2)
+    vocab_size, num_hidden, num_embed, batch = 10, 4, 4, 2
+    sym_gen = _sym_gen_factory(vocab_size, num_hidden, num_embed,
+                               batch)
+    from incubator_mxnet_tpu.ops.rnn import rnn_param_size
+    psize = rnn_param_size("lstm", 1, num_embed, num_hidden)
+
+    def shapes_for(bkey):
+        return ([mx.io.DataDesc("data", (batch, bkey)),
+                 mx.io.DataDesc("rnn_parameters", (psize,))],
+                [mx.io.DataDesc("softmax_label", (batch, bkey))])
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=8)
+    dsh, lsh = shapes_for(8)
+    mod.bind(data_shapes=dsh, label_shapes=lsh)
+    mod.init_params(_initializer())
+    mod.init_optimizer(kvstore=None, optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.5),))
+
+    def batch_for(bkey):
+        d = mx.nd.array(rs.randint(1, vocab_size, (batch, bkey)))
+        l = mx.nd.array(rs.randint(1, vocab_size, (batch, bkey)))
+        dsh_b, lsh_b = shapes_for(bkey)
+        return mx.io.DataBatch([d], [l], bucket_key=bkey,
+                               provide_data=dsh_b,
+                               provide_label=lsh_b)
+
+    b8 = batch_for(8)
+    mod.forward(b8, is_train=True)
+    mod.backward()
+    mod.update()
+    w8 = mod.get_params()[0]["embed_weight"].asnumpy()
+    # switch to a new bucket: params must carry over
+    b4 = batch_for(4)
+    mod.forward(b4, is_train=True)
+    w4 = mod.get_params()[0]["embed_weight"].asnumpy()
+    np.testing.assert_allclose(w8, w4, rtol=1e-6)

@@ -1,0 +1,257 @@
+"""RNN stack tests (model: reference tests/python/unittest/test_gluon_rnn.py
+— cell-vs-fused cross-checks are the consistency oracle, plus numpy
+reference recurrences)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, autograd
+from incubator_mxnet_tpu.gluon import rnn
+
+
+def _np_lstm_ref(x, w_ih, w_hh, b_ih, b_hh, h0, c0):
+    """Numpy LSTM, gate order i,f,g,o."""
+    T, N, C = x.shape
+    H = h0.shape[-1]
+    h, c = h0.copy(), c0.copy()
+    ys = []
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))  # noqa: E731
+    for t in range(T):
+        g = x[t] @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+        i, f, gg, o = np.split(g, 4, axis=-1)
+        c = sig(f) * c + sig(i) * np.tanh(gg)
+        h = sig(o) * np.tanh(c)
+        ys.append(h)
+    return np.stack(ys), h, c
+
+
+def test_fused_lstm_matches_numpy():
+    rs = np.random.RandomState(0)
+    T, N, C, H = 5, 3, 4, 6
+    x = rs.rand(T, N, C).astype(np.float32)
+    w_ih = rs.rand(4 * H, C).astype(np.float32) * 0.3
+    w_hh = rs.rand(4 * H, H).astype(np.float32) * 0.3
+    b_ih = rs.rand(4 * H).astype(np.float32) * 0.1
+    b_hh = rs.rand(4 * H).astype(np.float32) * 0.1
+    flat = np.concatenate([w_ih.ravel(), w_hh.ravel(), b_ih, b_hh])
+    h0 = np.zeros((1, N, H), np.float32)
+    c0 = np.zeros((1, N, H), np.float32)
+    out = nd.RNN(nd.array(x), nd.array(flat), nd.array(h0),
+                 nd.array(c0), state_size=H, num_layers=1,
+                 mode="lstm", state_outputs=True)
+    y, hT, cT = out
+    ref_y, ref_h, ref_c = _np_lstm_ref(x, w_ih, w_hh, b_ih, b_hh,
+                                       h0[0], c0[0])
+    np.testing.assert_allclose(y.asnumpy(), ref_y, rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(hT.asnumpy()[0], ref_h, rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(cT.asnumpy()[0], ref_c, rtol=1e-5,
+                               atol=1e-5)
+
+
+@pytest.mark.parametrize("mode,layer_cls,cell_cls", [
+    ("lstm", rnn.LSTM, rnn.LSTMCell),
+    ("gru", rnn.GRU, rnn.GRUCell),
+    ("rnn_relu", rnn.RNN, rnn.RNNCell),
+])
+def test_fused_layer_matches_cell_unroll(mode, layer_cls, cell_cls):
+    """The fused lax.scan layer and the step-by-step cell must agree
+    (the reference's test_rnn_cells consistency pattern)."""
+    mx.random.seed(42)
+    T, N, C, H = 4, 2, 3, 5
+    rs = np.random.RandomState(1)
+    x = rs.rand(N, T, C).astype(np.float32)
+
+    layer = layer_cls(H, num_layers=1, layout="NTC", input_size=C)
+    layer.initialize(mx.initializer.Uniform(0.2))
+    y_fused = layer(nd.array(x))
+
+    kw = {"activation": "relu"} if mode == "rnn_relu" else {}
+    cell = cell_cls(H, input_size=C, **kw)
+    cell.initialize()
+    lp = layer.collect_params()
+    for suffix in ["i2h_weight", "h2h_weight", "i2h_bias",
+                   "h2h_bias"]:
+        cell.params[cell.prefix + suffix].set_data(
+            lp[layer.prefix + "l0_" + suffix].data())
+    y_cell, _ = cell.unroll(T, nd.array(x), layout="NTC",
+                            merge_outputs=True)
+    np.testing.assert_allclose(y_fused.asnumpy(), y_cell.asnumpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_bidirectional_lstm_shapes_and_reverse():
+    T, N, C, H = 6, 2, 3, 4
+    layer = rnn.LSTM(H, num_layers=2, bidirectional=True,
+                     input_size=C)
+    layer.initialize()
+    x = nd.random.uniform(shape=(T, N, C))
+    out, states = layer(x, layer.begin_state(N))
+    assert out.shape == (T, N, 2 * H)
+    assert states[0].shape == (4, N, H)  # L*D
+    assert states[1].shape == (4, N, H)
+
+
+def test_rnn_layer_grad_flows():
+    layer = rnn.GRU(4, num_layers=2, input_size=3, dropout=0.1)
+    layer.initialize()
+    x = nd.random.uniform(shape=(5, 2, 3))
+    x.attach_grad()
+    with autograd.record():
+        y = layer(x)
+        loss = (y * y).sum()
+    loss.backward()
+    assert np.isfinite(x.grad.asnumpy()).all()
+    assert np.abs(x.grad.asnumpy()).sum() > 0
+    for _, p in layer.collect_params().items():
+        g = p.grad().asnumpy()
+        assert np.isfinite(g).all()
+
+
+def test_sequential_stack_and_modifiers():
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(4, input_size=3))
+    stack.add(rnn.DropoutCell(0.0))
+    stack.add(rnn.ResidualCell(rnn.GRUCell(4, input_size=4)))
+    stack.initialize()
+    x = nd.random.uniform(shape=(2, 5, 3))
+    out, states = stack.unroll(5, x, layout="NTC",
+                               merge_outputs=True)
+    assert out.shape == (2, 5, 4)
+    assert len(states) == 3  # lstm h,c + gru h
+
+
+def test_zoneout_cell_inference():
+    cell = rnn.ZoneoutCell(rnn.RNNCell(4, input_size=3),
+                           zoneout_outputs=0.3, zoneout_states=0.2)
+    cell.initialize()
+    x = nd.random.uniform(shape=(2, 5, 3))
+    out, _ = cell.unroll(5, x, layout="NTC", merge_outputs=True)
+    assert out.shape == (2, 5, 4)
+
+
+def test_bidirectional_cell_unroll():
+    bi = rnn.BidirectionalCell(rnn.LSTMCell(4, input_size=3),
+                               rnn.LSTMCell(4, input_size=3))
+    bi.initialize()
+    x = nd.random.uniform(shape=(2, 5, 3))
+    out, states = bi.unroll(5, x, layout="NTC", merge_outputs=True)
+    assert out.shape == (2, 5, 8)
+    assert len(states) == 4
+
+
+def test_unfuse_matches_fused():
+    T, N, C, H = 3, 2, 4, 5
+    layer = rnn.LSTM(H, num_layers=2, input_size=C)
+    layer.initialize(mx.initializer.Uniform(0.1))
+    x = nd.random.uniform(shape=(T, N, C))
+    y_fused = layer(x)
+    stack = layer._unfuse()
+    y_cells, _ = stack.unroll(T, x, layout="TNC", merge_outputs=True)
+    np.testing.assert_allclose(y_fused.asnumpy(), y_cells.asnumpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_rnn_in_hybrid_block_trains():
+    """Tiny LM-style model with a fused LSTM learns on random data."""
+    class Model(mx.gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.rnn = rnn.LSTM(16, input_size=8, layout="NTC")
+                self.out = mx.gluon.nn.Dense(4)
+
+        def hybrid_forward(self, F, x):
+            h = self.rnn(x)
+            return self.out(h.reshape((-1, 16)))
+
+        def forward(self, x):
+            from incubator_mxnet_tpu import nd as F
+            return self.hybrid_forward(F, x)
+
+    rs = np.random.RandomState(3)
+    net = Model()
+    net.initialize(mx.initializer.Xavier())
+    x = nd.array(rs.rand(4, 5, 8))
+    y = nd.array(rs.randint(0, 4, (20,)))
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = mx.gluon.Trainer(net.collect_params(), "adam",
+                               {"learning_rate": 0.01}, kvstore=None)
+    losses = []
+    for _ in range(25):
+        with autograd.record():
+            out = net(x)
+            loss = loss_fn(out, y).mean()
+        loss.backward()
+        trainer.step(1)
+        losses.append(float(loss.asnumpy()))
+    assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
+
+
+def test_unroll_valid_length_states():
+    """States returned with valid_length must be the state at each
+    sequence's last valid step, not after padding."""
+    cell = rnn.LSTMCell(4, input_size=3)
+    cell.initialize(mx.initializer.Uniform(0.2))
+    rs = np.random.RandomState(9)
+    x = nd.array(rs.rand(2, 6, 3))  # NTC
+    vl = nd.array(np.array([3, 6], np.float32))
+    out_v, states_v = cell.unroll(6, x, layout="NTC",
+                                  merge_outputs=True, valid_length=vl)
+    # oracle: unroll only the first 3 steps for sequence 0
+    out_3, states_3 = cell.unroll(
+        3, nd.array(rs.rand(0, 0, 0).reshape(0, 0, 0))
+        if False else x[:, :3], layout="NTC", merge_outputs=True)
+    np.testing.assert_allclose(states_v[0].asnumpy()[0],
+                               states_3[0].asnumpy()[0], rtol=1e-5)
+    np.testing.assert_allclose(states_v[1].asnumpy()[0],
+                               states_3[1].asnumpy()[0], rtol=1e-5)
+    # masked region of outputs must be zero
+    assert np.abs(out_v.asnumpy()[0, 3:]).sum() == 0
+
+
+def test_bidirectional_valid_length_full_equals_none():
+    """valid_length == full length must reproduce the plain unroll."""
+    bi = rnn.BidirectionalCell(rnn.LSTMCell(4, input_size=3),
+                               rnn.LSTMCell(4, input_size=3))
+    bi.initialize(mx.initializer.Uniform(0.2))
+    rs = np.random.RandomState(10)
+    x = nd.array(rs.rand(5, 2, 3))  # TNC
+    out_plain, _ = bi.unroll(5, x, layout="TNC", merge_outputs=True)
+    vl = nd.array(np.array([5, 5], np.float32))
+    out_vl, _ = bi.unroll(5, x, layout="TNC", merge_outputs=True,
+                          valid_length=vl)
+    np.testing.assert_allclose(out_plain.asnumpy(),
+                               out_vl.asnumpy(), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_unroll_list_inputs():
+    """Per-step list inputs infer batch from axis 0 of each step."""
+    cell = rnn.RNNCell(5, input_size=3)
+    cell.initialize()
+    rs = np.random.RandomState(11)
+    steps = [nd.array(rs.rand(2, 3)) for _ in range(4)]
+    out, states = cell.unroll(4, steps, layout="TNC",
+                              merge_outputs=True)
+    assert out.shape == (4, 2, 5)
+    assert states[0].shape == (2, 5)
+
+
+def test_lstm_state_clip():
+    rs = np.random.RandomState(12)
+    T, N, C, H = 4, 2, 3, 4
+    from incubator_mxnet_tpu.ops.rnn import rnn_param_size
+    psize = rnn_param_size("lstm", 1, C, H)
+    flat = nd.array(rs.rand(psize) * 2)
+    x = nd.array(rs.rand(T, N, C) * 3)
+    h0 = nd.zeros((1, N, H))
+    c0 = nd.zeros((1, N, H))
+    y, h, c = nd.RNN(x, flat, h0, c0, state_size=H, num_layers=1,
+                     mode="lstm", state_outputs=True,
+                     lstm_state_clip_min=-0.05,
+                     lstm_state_clip_max=0.05)
+    assert np.abs(c.asnumpy()).max() <= 0.05 + 1e-6
+    # outputs must reflect clipped recurrence: |y| <= tanh(0.05)
+    assert np.abs(y.asnumpy()).max() <= np.tanh(0.05) + 1e-6
