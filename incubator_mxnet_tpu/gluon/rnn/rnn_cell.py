@@ -40,19 +40,8 @@ def _format_sequence(length, inputs, layout, merge):
         batch_size = inputs.shape[batch_axis]
         L = inputs.shape[axis]
         assert length is None or L == length
-        from ... import nd
-        seq = [nd.squeeze(s, axis=axis) if hasattr(nd, "squeeze")
-               else s.reshape([d for i, d in enumerate(s.shape)
-                               if i != axis])
-               for s in nd_split(inputs, L, axis)]
+        seq = _split_steps(inputs, L, axis)
     return seq, axis, batch_size
-
-
-def nd_split(x, num, axis):
-    from ... import nd
-    outs = nd.SliceChannel(x, num_outputs=num, axis=axis,
-                            squeeze_axis=False)
-    return outs if isinstance(outs, (list, tuple)) else [outs]
 
 
 def _split_steps(x, num, axis):

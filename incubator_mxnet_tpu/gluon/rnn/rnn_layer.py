@@ -8,13 +8,12 @@ into the op's flat vector at forward — so checkpoints interop with the
 cell-based API."""
 
 from ...ndarray.ndarray import NDArray
+from ...ops.rnn import _GATES
 from ..block import HybridBlock
 from .rnn_cell import (RNNCell, LSTMCell, GRUCell, SequentialRNNCell,
                        BidirectionalCell)
 
 __all__ = ["RNN", "LSTM", "GRU"]
-
-_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
 
 
 class _RNNLayer(HybridBlock):

@@ -398,9 +398,8 @@ class MNISTIter(NDArrayIter):
 
 
 class LibSVMIter(DataIter):
-    """LibSVM text-format iterator (ref: src/io/iter_libsvm.cc:200).
-    Yields dense batches (sparse storage arrives with the sparse
-    milestone)."""
+    """LibSVM text-format iterator yielding CSR data batches (ref:
+    src/io/iter_libsvm.cc:200 — the reference also emits CSR)."""
 
     def __init__(self, data_libsvm, data_shape, label_shape=(1,),
                  batch_size=1, num_parts=1, part_index=0, **kwargs):
@@ -418,27 +417,43 @@ class LibSVMIter(DataIter):
                     row[int(i)] = float(v)
                 feats.append(row)
         feats = np.stack(feats)[part_index::num_parts]
-        labels = np.asarray(labels, np.float32)[part_index::num_parts]
-        self._inner = NDArrayIter(feats, labels, batch_size,
-                                  label_name="label")
+        self._labels = np.asarray(labels,
+                                  np.float32)[part_index::num_parts]
+        self._feats = feats
+        self._dim = dim
+        self._cursor = 0
         super().__init__(batch_size)
-
-    @property
-    def provide_data(self):
-        return self._inner.provide_data
-
-    @property
-    def provide_label(self):
-        return self._inner.provide_label
+        self.provide_data = [DataDesc("data", (batch_size, dim))]
+        self.provide_label = [DataDesc("label",
+                                       (batch_size,) + tuple(
+                                           label_shape)
+                                       if label_shape != (1,)
+                                       else (batch_size,))]
 
     def reset(self):
-        self._inner.reset()
-
-    def next(self):
-        return self._inner.next()
+        self._cursor = 0
 
     def iter_next(self):
-        return self._inner.iter_next()
+        return self._cursor < len(self._feats)
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        from ..ndarray import sparse as nd_sparse
+        from ..ndarray import array as nd_array
+        i = self._cursor
+        self._cursor += self.batch_size
+        chunk = self._feats[i:i + self.batch_size]
+        labels = self._labels[i:i + self.batch_size]
+        pad = self.batch_size - len(chunk)
+        if pad:  # pad the tail batch by wrapping (NDArrayIter 'pad')
+            chunk = np.concatenate([chunk, self._feats[:pad]])
+            labels = np.concatenate([labels, self._labels[:pad]])
+        data = nd_sparse.csr_matrix(chunk)
+        label = nd_array(labels)
+        return DataBatch([data], [label], pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
 
 
 def ImageRecordIter(*args, **kwargs):

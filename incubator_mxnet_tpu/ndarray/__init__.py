@@ -10,8 +10,9 @@ _internal = _register.populate(_sys.modules[__name__])
 
 from . import random   # noqa: E402
 from . import linalg   # noqa: E402
+from . import sparse  # noqa: E402
 from .sparse import CSRNDArray, RowSparseNDArray  # noqa: E402
 
 __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
            "concatenate", "waitall", "moveaxis", "save", "load", "random",
-           "linalg", "CSRNDArray", "RowSparseNDArray"]
+           "linalg", "sparse", "CSRNDArray", "RowSparseNDArray"]

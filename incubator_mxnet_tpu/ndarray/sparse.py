@@ -51,15 +51,13 @@ class CSRNDArray(BaseSparseNDArray):
         return self._sp_indptr
 
     def _todense_impl(self):
-        rows, cols = self._sp_shape
         indptr = np.asarray(self._sp_indptr._data)
-        indices = np.asarray(self._sp_indices._data)
-        vals = np.asarray(self._sp_data._data)
-        out = np.zeros(self._sp_shape, vals.dtype)
-        for r in range(rows):
-            for p in range(indptr[r], indptr[r + 1]):
-                out[r, indices[p]] = vals[p]
-        return jnp.asarray(out)
+        row_ids = np.repeat(np.arange(len(indptr) - 1),
+                            np.diff(indptr))
+        cols = self._sp_indices._data.astype(jnp.int32)
+        vals = self._sp_data._data
+        return jnp.zeros(self._sp_shape, vals.dtype).at[
+            jnp.asarray(row_ids, jnp.int32), cols].set(vals)
 
     def tostype(self, stype):
         if stype == "csr":
@@ -113,16 +111,14 @@ def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
 
 
 def _dense_to_csr(dense, shape):
-    indptr = [0]
-    indices, vals = [], []
-    for r in range(dense.shape[0]):
-        nz = np.nonzero(dense[r])[0]
-        indices.extend(nz.tolist())
-        vals.extend(dense[r][nz].tolist())
-        indptr.append(len(indices))
-    return CSRNDArray(_dense_array(np.asarray(vals, dense.dtype)),
-                      _dense_array(np.asarray(indices), dtype="int64"),
-                      _dense_array(np.asarray(indptr), dtype="int64"),
+    rows, cols = np.nonzero(dense)
+    vals = dense[rows, cols]
+    indptr = np.zeros(dense.shape[0] + 1, np.int64)
+    np.cumsum(np.bincount(rows, minlength=dense.shape[0]),
+              out=indptr[1:])
+    return CSRNDArray(_dense_array(np.ascontiguousarray(vals)),
+                      _dense_array(cols, dtype="int64"),
+                      _dense_array(indptr, dtype="int64"),
                       shape)
 
 
@@ -160,3 +156,146 @@ def zeros(stype, shape, ctx=None, dtype="float32"):
         return _dense_to_csr(np.zeros(shape, dtype), shape)
     from .ndarray import zeros as dzeros
     return dzeros(shape, ctx, dtype)
+
+
+# ---------------------------------------------------------------------------
+# sparse kernels (ref: src/operator/tensor/dot.cc CSR paths,
+# sparse_retain.cc, optimizer_op.cc MXNET_ADD_SPARSE_OP_ALIAS lazy
+# updates).  TPU-native: nnz is static per array, so gather +
+# segment-sum tile cleanly onto the MXU/VPU under jit.
+# ---------------------------------------------------------------------------
+
+
+def _csr_row_ids(csr):
+    """Expand indptr to one row id per nonzero (host-side, cached)."""
+    if not hasattr(csr, "_row_ids_cache"):
+        indptr = np.asarray(csr._sp_indptr._data)
+        counts = np.diff(indptr)
+        csr._row_ids_cache = jnp.asarray(
+            np.repeat(np.arange(len(counts)), counts), jnp.int32)
+    return csr._row_ids_cache
+
+
+def dot(lhs, rhs, transpose_a=False):
+    """Sparse-aware dot (ref: dot.cc dot(csr,dense)/dot(csr.T,dense)).
+
+    dot(csr, dense) -> dense; dot(csr.T, dense) -> dense (the
+    embedding-gradient shape); dot(rowsparse, dense) -> dense;
+    otherwise falls back to dense dot."""
+    import jax
+    if isinstance(lhs, CSRNDArray):
+        vals = lhs._sp_data._data
+        cols = lhs._sp_indices._data.astype(jnp.int32)
+        rows = _csr_row_ids(lhs)
+        n_rows, n_cols = lhs._sp_shape
+        d = rhs._data
+        if not transpose_a:
+            # out[r] = sum_nz vals * d[cols]  grouped by row
+            contrib = vals[:, None] * jnp.take(d, cols, axis=0)
+            out = jax.ops.segment_sum(contrib, rows,
+                                      num_segments=n_rows)
+        else:
+            # out[c] += vals * d[rows]  (scatter-add over columns)
+            contrib = vals[:, None] * jnp.take(d, rows, axis=0)
+            out = jnp.zeros((n_cols, d.shape[1]), d.dtype).at[
+                cols].add(contrib)
+        return NDArray(out)
+    if isinstance(lhs, RowSparseNDArray) and not transpose_a:
+        idx = lhs._sp_indices._data.astype(jnp.int32)
+        out = jnp.zeros((lhs._sp_shape[0], rhs._data.shape[1]),
+                        rhs._data.dtype)
+        out = out.at[idx].set(lhs._sp_data._data @ rhs._data)
+        return NDArray(out)
+    return NDArray(jnp.matmul(
+        lhs._data.T if transpose_a else lhs._data, rhs._data))
+
+
+def retain(data, indices):
+    """Keep only the requested rows of a row-sparse array (ref:
+    src/operator/tensor/sparse_retain.cc)."""
+    assert isinstance(data, RowSparseNDArray), "retain needs row_sparse"
+    want = indices._data.astype(jnp.int32) if isinstance(
+        indices, NDArray) else jnp.asarray(indices, jnp.int32)
+    rows = jnp.take(data._data, want, axis=0)
+    return RowSparseNDArray(NDArray(rows), NDArray(want),
+                            data._sp_shape)
+
+
+def elemwise_add(lhs, rhs):
+    """row_sparse + row_sparse -> row_sparse.  Stays on device: the
+    result's index set is the (fixed-capacity) concatenation of both
+    index sets — duplicates are harmless because reconstruction
+    writes the same summed row for each copy."""
+    if isinstance(lhs, RowSparseNDArray) and \
+            isinstance(rhs, RowSparseNDArray):
+        dense = lhs._data + rhs._data
+        idx = jnp.concatenate([
+            lhs._sp_indices._data.astype(jnp.int32),
+            rhs._sp_indices._data.astype(jnp.int32)])
+        rows = jnp.take(dense, idx, axis=0)
+        return RowSparseNDArray(NDArray(rows), NDArray(idx),
+                                lhs._sp_shape)
+    return NDArray(lhs._data + rhs._data)
+
+
+add = elemwise_add
+
+
+def sgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0,
+               clip_gradient=None, out=None):
+    """Lazy SGD: only rows present in the row-sparse grad are updated
+    (ref: optimizer_op.cc sparse sgd_update alias — 'lazy update')."""
+    if isinstance(grad, RowSparseNDArray):
+        idx = grad._sp_indices._data.astype(jnp.int32)
+        g = grad._sp_data._data * rescale_grad
+        if clip_gradient is not None:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        w = weight._data
+        rows = jnp.take(w, idx, axis=0)
+        new_rows = rows - lr * (g + wd * rows)
+        new_w = w.at[idx].set(new_rows)
+    else:
+        g = grad._data * rescale_grad
+        if clip_gradient is not None:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        new_w = weight._data - lr * (g + wd * weight._data)
+    target = out if out is not None else weight
+    target._data = new_w
+    return target
+
+
+def adam_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                clip_gradient=None, t=1, out=None):
+    """Lazy Adam on row-sparse grads (ref: optimizer_op.cc
+    adam_update sparse alias)."""
+    coef1 = 1.0 - beta1 ** t
+    coef2 = 1.0 - beta2 ** t
+    lr_t = lr * (coef2 ** 0.5) / coef1
+    if isinstance(grad, RowSparseNDArray):
+        idx = grad._sp_indices._data.astype(jnp.int32)
+        g = grad._sp_data._data * rescale_grad
+        if clip_gradient is not None:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        w, m, v = weight._data, mean._data, var._data
+        w_rows = jnp.take(w, idx, axis=0)
+        g = g + wd * w_rows
+        m_rows = beta1 * jnp.take(m, idx, axis=0) + (1 - beta1) * g
+        v_rows = beta2 * jnp.take(v, idx, axis=0) + \
+            (1 - beta2) * g * g
+        w_rows = w_rows - lr_t * m_rows / (jnp.sqrt(v_rows) + epsilon)
+        mean._data = m.at[idx].set(m_rows)
+        var._data = v.at[idx].set(v_rows)
+        new_w = w.at[idx].set(w_rows)
+    else:
+        g = grad._data * rescale_grad
+        if clip_gradient is not None:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        g = g + wd * weight._data
+        mean._data = beta1 * mean._data + (1 - beta1) * g
+        var._data = beta2 * var._data + (1 - beta2) * g * g
+        new_w = weight._data - lr_t * mean._data / (
+            jnp.sqrt(var._data) + epsilon)
+    target = out if out is not None else weight
+    target._data = new_w
+    return target

@@ -105,6 +105,10 @@ class BucketSentenceIter(DataIter):
         self.ndlabel = []
         from .. import nd
         for buck in self.data:
+            if len(buck) == 0:  # an explicit bucket got no sentences
+                self.nddata.append(None)
+                self.ndlabel.append(None)
+                continue
             label = np.empty_like(buck)
             label[:, :-1] = buck[:, 1:]
             label[:, -1] = self.invalid_label

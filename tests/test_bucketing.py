@@ -159,3 +159,12 @@ def test_bucketing_param_sync_across_buckets():
     mod.forward(b4, is_train=True)
     w4 = mod.get_params()[0]["embed_weight"].asnumpy()
     np.testing.assert_allclose(w8, w4, rtol=1e-6)
+
+
+def test_bucket_iter_empty_bucket_ok():
+    """An explicit bucket with no sentences must not crash."""
+    it = mx.rnn.BucketSentenceIter([[1, 2, 3], [1, 2, 3, 4]],
+                                   batch_size=1, buckets=[4, 30],
+                                   invalid_label=0)
+    n = sum(1 for _ in it)
+    assert n == 2

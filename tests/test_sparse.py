@@ -1,0 +1,160 @@
+"""Sparse storage + kernels (model: reference
+tests/python/unittest/test_sparse_ndarray.py / test_sparse_operator.py
+and example/sparse/linear_classification.py — config 5).
+Oracle: dense numpy."""
+import os
+import tempfile
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.ndarray import sparse
+
+
+def _rand_csr(rs, shape, density=0.3):
+    dense = rs.rand(*shape).astype(np.float32)
+    dense[rs.rand(*shape) > density] = 0
+    return dense, sparse.csr_matrix(dense)
+
+
+def test_csr_roundtrip():
+    rs = np.random.RandomState(0)
+    dense, csr = _rand_csr(rs, (6, 9))
+    assert csr.stype == "csr"
+    np.testing.assert_allclose(csr.asnumpy(), dense)
+    back = csr.tostype("default")
+    np.testing.assert_allclose(back.asnumpy(), dense)
+    csr2 = sparse.cast_storage(nd.array(dense), "csr")
+    np.testing.assert_allclose(csr2.asnumpy(), dense)
+
+
+def test_row_sparse_roundtrip():
+    rs = np.random.RandomState(1)
+    dense = np.zeros((8, 4), np.float32)
+    rows = [1, 3, 6]
+    dense[rows] = rs.rand(3, 4)
+    rsp = sparse.row_sparse_array(dense)
+    assert rsp.stype == "row_sparse"
+    np.testing.assert_allclose(rsp.asnumpy(), dense)
+    np.testing.assert_allclose(np.asarray(rsp.indices.asnumpy()),
+                               rows)
+
+
+def test_sparse_dot_csr_dense():
+    rs = np.random.RandomState(2)
+    dense, csr = _rand_csr(rs, (5, 7))
+    w = rs.rand(7, 3).astype(np.float32)
+    out = sparse.dot(csr, nd.array(w))
+    np.testing.assert_allclose(out.asnumpy(), dense @ w, rtol=1e-5)
+
+
+def test_sparse_dot_csr_T_dense():
+    rs = np.random.RandomState(3)
+    dense, csr = _rand_csr(rs, (5, 7))
+    w = rs.rand(5, 3).astype(np.float32)
+    out = sparse.dot(csr, nd.array(w), transpose_a=True)
+    np.testing.assert_allclose(out.asnumpy(), dense.T @ w, rtol=1e-5)
+
+
+def test_sparse_retain():
+    rs = np.random.RandomState(4)
+    dense = rs.rand(6, 3).astype(np.float32)
+    rsp = sparse.row_sparse_array(dense)
+    kept = sparse.retain(rsp, nd.array(np.array([1, 4])))
+    want = np.zeros_like(dense)
+    want[[1, 4]] = dense[[1, 4]]
+    np.testing.assert_allclose(kept.asnumpy(), want)
+
+
+def test_lazy_sgd_update():
+    rs = np.random.RandomState(5)
+    w = rs.rand(6, 3).astype(np.float32)
+    g_dense = np.zeros_like(w)
+    g_rows = [0, 2]
+    g_dense[g_rows] = rs.rand(2, 3)
+    weight = nd.array(w)
+    grad = sparse.row_sparse_array(g_dense)
+    sparse.sgd_update(weight, grad, lr=0.1, wd=0.01)
+    want = w.copy()
+    want[g_rows] = w[g_rows] - 0.1 * (g_dense[g_rows]
+                                      + 0.01 * w[g_rows])
+    np.testing.assert_allclose(weight.asnumpy(), want, rtol=1e-5)
+    # untouched rows stay exactly (lazy semantics)
+    np.testing.assert_array_equal(weight.asnumpy()[1], w[1])
+
+
+def test_kvstore_row_sparse_pull():
+    kv = mx.kvstore.create("local")
+    w = np.arange(12, dtype=np.float32).reshape(4, 3)
+    kv.init("emb", nd.array(w))
+    out = nd.zeros((4, 3))
+    kv.row_sparse_pull("emb", out=out,
+                       row_ids=nd.array(np.array([1, 3])))
+    want = np.zeros_like(w)
+    want[[1, 3]] = w[[1, 3]]
+    np.testing.assert_allclose(out.asnumpy(), want)
+
+
+def test_libsvm_iter_and_linear_classification():
+    """config-5 miniature: logistic regression on LibSVM CSR data."""
+    rs = np.random.RandomState(6)
+    dim, n = 16, 64
+    true_w = rs.randn(dim).astype(np.float32)
+    xs = (rs.rand(n, dim) * (rs.rand(n, dim) < 0.4)).astype(np.float32)
+    ys = (xs @ true_w > 0).astype(np.float32)
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "train.libsvm")
+        with open(path, "w") as f:
+            for x, y in zip(xs, ys):
+                toks = [f"{i}:{v}" for i, v in enumerate(x) if v != 0]
+                f.write(f"{y} " + " ".join(toks) + "\n")
+        it = mx.io.LibSVMIter(data_libsvm=path, data_shape=(dim,),
+                              batch_size=16)
+        batches = list(it)
+        assert len(batches) == 4
+        assert batches[0].data[0].stype == "csr"
+
+        weight = nd.array(np.zeros((dim, 1), np.float32))
+        losses = []
+        for _ in range(30):
+            it.reset()
+            total = 0.0
+            for b in batches:
+                x_csr, y_b = b.data[0], b.label[0].asnumpy()
+                logits = sparse.dot(x_csr, weight).asnumpy()[:, 0]
+                p = 1 / (1 + np.exp(-logits))
+                total += -np.mean(y_b * np.log(p + 1e-8) +
+                                  (1 - y_b) * np.log(1 - p + 1e-8))
+                gl = nd.array((p - y_b)[:, None] / len(y_b))
+                g = sparse.dot(x_csr, gl, transpose_a=True)
+                sparse.sgd_update(weight, g, lr=1.0)
+            losses.append(total / len(batches))
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_libsvm_iter_pads_tail_batch():
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "t.libsvm")
+        with open(path, "w") as f:
+            for i in range(10):
+                f.write(f"{i % 2} 0:1.0 {i % 4}:2.0\n")
+        it = mx.io.LibSVMIter(data_libsvm=path, data_shape=(5,),
+                              batch_size=4)
+        batches = list(it)
+        assert len(batches) == 3
+        assert batches[-1].pad == 2
+        assert batches[-1].data[0].shape == (4, 5)
+
+
+def test_row_sparse_elemwise_add():
+    rs = np.random.RandomState(7)
+    a = np.zeros((6, 3), np.float32)
+    b = np.zeros((6, 3), np.float32)
+    a[[0, 2]] = rs.rand(2, 3)
+    b[[2, 5]] = rs.rand(2, 3)
+    out = sparse.elemwise_add(sparse.row_sparse_array(a),
+                              sparse.row_sparse_array(b))
+    assert out.stype == "row_sparse"
+    np.testing.assert_allclose(out.asnumpy(), a + b, rtol=1e-6)
