@@ -46,6 +46,8 @@ from .module import Module
 from .io import DataBatch, DataDesc, DataIter, NDArrayIter
 from . import gluon
 from . import rnn
+from . import recordio
+from . import image
 from . import parallel
 
 __all__ = ["nd", "ndarray", "autograd", "Context", "cpu", "tpu", "gpu",

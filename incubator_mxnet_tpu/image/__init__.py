@@ -1,0 +1,15 @@
+"""Image pipeline (ref: python/mxnet/image/ + src/io/ image stack)."""
+from .image import (imdecode, imresize, resize_short, fixed_crop,
+                    center_crop, random_crop, color_normalize,
+                    Augmenter, ResizeAug, ForceResizeAug, CastAug,
+                    HorizontalFlipAug, RandomCropAug, CenterCropAug,
+                    ColorNormalizeAug, BrightnessJitterAug,
+                    CreateAugmenter, ImageIter)
+from .record_iter import ImageRecordIter
+
+__all__ = ["imdecode", "imresize", "resize_short", "fixed_crop",
+           "center_crop", "random_crop", "color_normalize",
+           "Augmenter", "ResizeAug", "ForceResizeAug", "CastAug",
+           "HorizontalFlipAug", "RandomCropAug", "CenterCropAug",
+           "ColorNormalizeAug", "BrightnessJitterAug",
+           "CreateAugmenter", "ImageIter", "ImageRecordIter"]

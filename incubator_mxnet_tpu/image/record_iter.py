@@ -1,0 +1,202 @@
+"""ImageRecordIter: the production image pipeline (ref:
+src/io/iter_image_recordio_2.cc ImageRecordIter2:660 — N decode
+threads + augment + BatchLoader + double-buffered PrefetcherIter,
+src/io/iter_prefetcher.h:47).
+
+Same architecture, host-side: a thread pool decodes+augments records
+in parallel (PIL releases the GIL around codec work), a batcher
+assembles NCHW arrays, and a one-slot-deep background prefetcher
+overlaps the next batch's decode with the current device step —
+the dmlc ThreadedIter double-buffer."""
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+import warnings
+
+from .. import recordio as rio
+from ..io.io import DataBatch, DataDesc, DataIter
+from ..ndarray.ndarray import array as nd_array
+from .image import CreateAugmenter, augment_to_chw, imdecode
+
+__all__ = ["ImageRecordIter"]
+
+
+class ImageRecordIter(DataIter):
+    """Reads .rec (+ optional .idx) shards (ref:
+    iter_image_recordio_2.cc; python surface matches the reference's
+    generated ImageRecordIter)."""
+
+    def __init__(self, path_imgrec, data_shape, batch_size,
+                 label_width=1, shuffle=False, rand_crop=False,
+                 rand_mirror=False, mean_r=0, mean_g=0, mean_b=0,
+                 std_r=0, std_g=0, std_b=0, resize=0,
+                 preprocess_threads=4, prefetch_buffer=2,
+                 data_name="data", label_name="softmax_label",
+                 round_batch=True, num_parts=1, part_index=0,
+                 aug_list=None, **kwargs):
+        super().__init__(batch_size)
+        if kwargs:
+            warnings.warn(
+                f"ImageRecordIter: ignoring unsupported options "
+                f"{sorted(kwargs)}")
+        self.round_batch = round_batch
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.shuffle = shuffle
+        mean = [mean_r, mean_g, mean_b] if (mean_r or mean_g or
+                                            mean_b) else None
+        std = [std_r, std_g, std_b] if (std_r or std_g or std_b) \
+            else None
+        self.auglist = aug_list if aug_list is not None else \
+            CreateAugmenter(self.data_shape, resize=resize,
+                            rand_crop=rand_crop,
+                            rand_mirror=rand_mirror, mean=mean,
+                            std=std)
+        self._pool = ThreadPoolExecutor(max_workers=preprocess_threads)
+        # load the record offsets once; shuffle epoch-wise
+        import os
+        idx_path = os.path.splitext(path_imgrec)[0] + ".idx"
+        if os.path.exists(idx_path):
+            self._rec = rio.MXIndexedRecordIO(idx_path, path_imgrec,
+                                              "r")
+            keys = list(self._rec.keys)[part_index::num_parts]
+            self._keys = keys
+        else:
+            self._rec = rio.MXRecordIO(path_imgrec, "r")
+            self._keys = None
+            assert num_parts == 1, \
+                "sharded reads need an .idx file"
+        self._lock = threading.Lock()
+        self.provide_data = [DataDesc(data_name,
+                                      (batch_size,) + self.data_shape)]
+        lshape = (batch_size,) if label_width == 1 \
+            else (batch_size, label_width)
+        self.provide_label = [DataDesc(label_name, lshape)]
+        self._prefetch_q = queue.Queue(maxsize=prefetch_buffer)
+        self._producer = None
+        self._stop = threading.Event()
+        self.reset()
+
+    # ------------------------------------------------------------ epoch
+    def reset(self):
+        self._drain()
+        if self._keys is not None and self.shuffle:
+            np.random.shuffle(self._keys)
+        if self._keys is None:
+            self._rec.reset()
+        self._cursor = 0
+        self._stop.clear()
+        self._producer = threading.Thread(target=self._produce,
+                                          daemon=True)
+        self._producer.start()
+
+    def _drain(self):
+        """Stop the producer and empty the queue race-free: the
+        producer's stop-aware put() exits on _stop, we JOIN it, and
+        only then drain — so no stale item can land after the drain
+        (the mid-epoch-reset hazard of a naive drain-then-join)."""
+        if self._producer is not None:
+            self._stop.set()
+            while self._producer.is_alive():
+                try:  # unblock a producer waiting in put()
+                    self._prefetch_q.get_nowait()
+                except queue.Empty:
+                    pass
+                self._producer.join(timeout=0.05)
+            self._producer = None
+        try:
+            while True:
+                self._prefetch_q.get_nowait()
+        except queue.Empty:
+            pass
+
+    # ------------------------------------------------------------ workers
+    def _read_raw(self, i):
+        with self._lock:
+            if self._keys is not None:
+                return self._rec.read_idx(self._keys[i])
+            return self._rec.read()
+
+    def _decode_one(self, raw):
+        header, img_bytes = rio.unpack(raw)
+        arr = augment_to_chw(imdecode(img_bytes), self.auglist)
+        label = np.atleast_1d(np.asarray(header.label, np.float32))
+        return arr, label
+
+    def _put(self, item):
+        """Stop-aware put so a blocked producer can exit on reset."""
+        while not self._stop.is_set():
+            try:
+                self._prefetch_q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _produce(self):
+        try:
+            n = len(self._keys) if self._keys is not None else None
+            i = 0
+            while not self._stop.is_set():
+                raws = []
+                while len(raws) < self.batch_size:
+                    if n is not None and i >= n:
+                        break
+                    raw = self._read_raw(i)
+                    if raw is None:
+                        break
+                    raws.append(raw)
+                    i += 1
+                if not raws:
+                    break
+                pad = self.batch_size - len(raws)
+                if pad > 0 and self.round_batch and n is not None:
+                    # wrap the tail with epoch-start samples (ref:
+                    # round_batch semantics of the C++ iterator)
+                    for j in range(pad):
+                        raws.append(self._read_raw(j % n))
+                decoded = list(self._pool.map(self._decode_one, raws))
+                c, h, w = self.data_shape
+                data = np.zeros((self.batch_size, c, h, w),
+                                np.float32)
+                label = np.zeros((self.batch_size, self.label_width),
+                                 np.float32)
+                for j, (arr, lab) in enumerate(decoded):
+                    data[j] = arr
+                    label[j] = lab[:self.label_width]
+                if not self._put((data, label, pad)):
+                    return  # reset() interrupted us; no sentinel
+                if pad > 0:
+                    break
+            self._put(None)  # epoch sentinel
+        except Exception as e:  # surface errors in the consumer
+            self._put(("error", e))
+
+    # ------------------------------------------------------------ iter
+    def next(self):
+        if self._producer is None:
+            raise StopIteration  # epoch ended; call reset()
+        item = self._prefetch_q.get()
+        if item is None:
+            self._producer.join(timeout=5)
+            self._producer = None
+            raise StopIteration
+        if isinstance(item, tuple) and len(item) == 2 and \
+                item[0] == "error":
+            self._producer = None
+            raise item[1]
+        data, label, pad = item
+        label_out = label[:, 0] if self.label_width == 1 else label
+        return DataBatch([nd_array(data)], [nd_array(label_out)],
+                         pad=pad, provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+    def __del__(self):
+        try:
+            self._drain()
+            self._pool.shutdown(wait=False)
+        except Exception:
+            pass

@@ -1,0 +1,172 @@
+// RecordIO: the framework's native record-packing format.
+//
+// Role analog of dmlc-core's RecordIO (the reference reads datasets
+// through dmlc::RecordIOReader/Writer inside src/io/
+// iter_image_recordio_2.cc and tools/im2rec.cc packs them).  Format
+// compatible with the reference so existing .rec datasets load:
+//   [uint32 magic=0xced7230a][uint32 lrec][data][pad to 4B]
+//   lrec = (cflag << 29) | length ; cflag: 0=whole, 1=start,
+//   2=middle, 3=end of a split record (magic bytes inside data are
+//   escaped by splitting).
+//
+// Exposed as a C ABI for ctypes (python/.../recordio.py); no
+// dependency on anything but libc, so a single `g++ -shared` builds
+// it anywhere.
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+
+inline uint32_t LowerBits(uint32_t lrec) { return lrec & ((1u << 29) - 1); }
+inline uint32_t CFlag(uint32_t lrec) { return lrec >> 29; }
+inline uint32_t MakeLRec(uint32_t cflag, uint32_t len) {
+  return (cflag << 29) | len;
+}
+
+struct Writer {
+  FILE* fp;
+};
+
+struct Reader {
+  FILE* fp;
+  std::vector<char> buf;
+};
+
+// find next occurrence of magic in [p, end); returns end if none
+const char* FindMagic(const char* p, const char* end) {
+  const char magic_bytes[4] = {0x0a, 0x23, static_cast<char>(0xd7),
+                               static_cast<char>(0xce)};  // LE layout
+  for (; p + 4 <= end; ++p) {
+    if (memcmp(p, magic_bytes, 4) == 0) return p;
+  }
+  return end;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* rio_writer_open(const char* path, int append) {
+  FILE* fp = fopen(path, append ? "ab" : "wb");
+  if (!fp) return nullptr;
+  return new Writer{fp};
+}
+
+// Write one logical record, splitting at embedded magic words the
+// way dmlc-core does so readers can resynchronize.
+int64_t rio_writer_write(void* handle, const char* data, uint64_t size) {
+  Writer* w = static_cast<Writer*>(handle);
+  const char* p = data;
+  const char* end = data + size;
+  // collect chunk boundaries at embedded magics
+  std::vector<std::pair<const char*, uint64_t>> chunks;
+  const char* cur = p;
+  while (true) {
+    const char* hit = FindMagic(cur, end);
+    chunks.emplace_back(cur, static_cast<uint64_t>(hit - cur));
+    if (hit >= end) break;  // k magics -> k+1 chunks, possibly empty
+    cur = hit + 4;
+  }
+  int64_t written = 0;
+  for (size_t i = 0; i < chunks.size(); ++i) {
+    uint32_t cflag;
+    if (chunks.size() == 1) {
+      cflag = 0;
+    } else if (i == 0) {
+      cflag = 1;
+    } else if (i + 1 == chunks.size()) {
+      cflag = 3;
+    } else {
+      cflag = 2;
+    }
+    uint32_t magic = kMagic;
+    uint32_t lrec = MakeLRec(cflag, static_cast<uint32_t>(chunks[i].second));
+    if (fwrite(&magic, 4, 1, w->fp) != 1) return -1;
+    if (fwrite(&lrec, 4, 1, w->fp) != 1) return -1;
+    if (chunks[i].second &&
+        fwrite(chunks[i].first, 1, chunks[i].second, w->fp) !=
+            chunks[i].second)
+      return -1;
+    uint64_t pad = (4 - (chunks[i].second & 3)) & 3;
+    const char zeros[4] = {0, 0, 0, 0};
+    if (pad && fwrite(zeros, 1, pad, w->fp) != pad) return -1;
+    written += 8 + chunks[i].second + pad;
+  }
+  return written;
+}
+
+int64_t rio_writer_tell(void* handle) {
+  return ftell(static_cast<Writer*>(handle)->fp);
+}
+
+void rio_writer_close(void* handle) {
+  Writer* w = static_cast<Writer*>(handle);
+  fclose(w->fp);
+  delete w;
+}
+
+void* rio_reader_open(const char* path) {
+  FILE* fp = fopen(path, "rb");
+  if (!fp) return nullptr;
+  return new Reader{fp, {}};
+}
+
+void rio_reader_seek(void* handle, int64_t pos) {
+  fseek(static_cast<Reader*>(handle)->fp, pos, SEEK_SET);
+}
+
+int64_t rio_reader_tell(void* handle) {
+  return ftell(static_cast<Reader*>(handle)->fp);
+}
+
+// Read the next logical record (re-joining split chunks).  Returns
+// record length >= 0 (0 is a valid empty record), -1 on EOF, -2 on
+// corruption.  Data stays valid until the next call; fetch with
+// rio_reader_data.
+int64_t rio_reader_next(void* handle) {
+  Reader* r = static_cast<Reader*>(handle);
+  r->buf.clear();
+  bool in_split = false;
+  bool read_any = false;
+  while (true) {
+    uint32_t magic, lrec;
+    if (fread(&magic, 4, 1, r->fp) != 1) return read_any ? -2 : -1;
+    read_any = true;
+    if (magic != kMagic) return -2;
+    if (fread(&lrec, 4, 1, r->fp) != 1) return -2;
+    uint32_t len = LowerBits(lrec);
+    uint32_t cflag = CFlag(lrec);
+    size_t off = r->buf.size();
+    if (in_split) {
+      // re-insert the escaped magic between chunks
+      const char magic_bytes[4] = {0x0a, 0x23, static_cast<char>(0xd7),
+                                   static_cast<char>(0xce)};
+      r->buf.insert(r->buf.end(), magic_bytes, magic_bytes + 4);
+      off += 4;
+    }
+    r->buf.resize(off + len);
+    if (len && fread(r->buf.data() + off, 1, len, r->fp) != len) return -2;
+    uint64_t pad = (4 - (len & 3)) & 3;
+    if (pad) fseek(r->fp, pad, SEEK_CUR);
+    if (cflag == 0 || cflag == 3) break;
+    in_split = true;
+  }
+  return static_cast<int64_t>(r->buf.size());
+}
+
+const char* rio_reader_data(void* handle) {
+  return static_cast<Reader*>(handle)->buf.data();
+}
+
+void rio_reader_close(void* handle) {
+  Reader* r = static_cast<Reader*>(handle);
+  fclose(r->fp);
+  delete r;
+}
+
+}  // extern "C"

@@ -1,0 +1,243 @@
+"""RecordIO + image pipeline tests (model: reference
+tests/python/unittest/test_recordio.py / test_image.py; oracle:
+roundtrip identity and the pure-Python backend vs the native one)."""
+import glob
+import os
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import recordio as rio
+
+
+def test_recordio_roundtrip():
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "t.rec")
+        w = rio.MXRecordIO(path, "w")
+        records = [b"hello", b"x" * 1000, b"", b"abc" * 77]
+        for r in records:
+            w.write(r)
+        w.close()
+        r = rio.MXRecordIO(path, "r")
+        got = []
+        while True:
+            rec = r.read()
+            if rec is None:
+                break
+            got.append(rec)
+        r.close()
+        assert got == records
+
+
+def test_recordio_embedded_magic():
+    """Records containing the magic word must survive (split+rejoin
+    escaping, ref: dmlc recordio)."""
+    import struct
+    magic = struct.pack("<I", 0xced7230a)
+    payload = b"A" * 10 + magic + b"B" * 7 + magic + magic + b"C"
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "t.rec")
+        w = rio.MXRecordIO(path, "w")
+        w.write(payload)
+        w.write(magic)
+        w.close()
+        r = rio.MXRecordIO(path, "r")
+        assert r.read() == payload
+        assert r.read() == magic
+        assert r.read() is None
+        r.close()
+
+
+def test_native_and_python_backends_agree():
+    """The C++ writer's bytes must be readable by the Python reader
+    and vice versa."""
+    if rio._native_lib() is None:
+        pytest.skip("native recordio unavailable")
+    payload = [b"abc", b"d" * 501, struct_magic()]
+    with tempfile.TemporaryDirectory() as td:
+        p1 = os.path.join(td, "native.rec")
+        w = rio.MXRecordIO(p1, "w")
+        assert w._lib is not None  # native
+        for r in payload:
+            w.write(r)
+        w.close()
+        r1 = rio.MXRecordIO(p1, "r")
+        r1._lib = None  # force python reader
+        r1.reset()
+        got = [r1.read() for _ in payload]
+        assert got == payload
+        r1.close()
+        # python writer -> native reader
+        p2 = os.path.join(td, "py.rec")
+        w2 = rio.MXRecordIO(p2, "w")
+        w2._lib = None
+        w2.reset()
+        for r in payload:
+            w2.write(r)
+        w2.close()
+        r2 = rio.MXRecordIO(p2, "r")
+        assert r2._lib is not None
+        got = [r2.read() for _ in payload]
+        assert got == payload
+        r2.close()
+
+
+def struct_magic():
+    import struct
+    return b"Z" * 3 + struct.pack("<I", 0xced7230a) + b"Q" * 9
+
+
+def test_indexed_recordio():
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "t.rec")
+        idxp = os.path.join(td, "t.idx")
+        w = rio.MXIndexedRecordIO(idxp, path, "w")
+        for i in range(10):
+            w.write_idx(i, f"record-{i}".encode())
+        w.close()
+        assert os.path.exists(idxp)
+        r = rio.MXIndexedRecordIO(idxp, path, "r")
+        assert r.read_idx(7) == b"record-7"
+        assert r.read_idx(0) == b"record-0"
+        assert r.read_idx(9) == b"record-9"
+        r.close()
+
+
+def test_pack_unpack_img():
+    rs = np.random.RandomState(0)
+    img = (rs.rand(32, 24, 3) * 255).astype(np.uint8)
+    header = rio.IRHeader(0, 3.0, 42, 0)
+    s = rio.pack_img(header, img, quality=100, img_fmt=".png")
+    h2, img2 = rio.unpack_img(s)
+    assert h2.label == 3.0 and h2.id == 42
+    np.testing.assert_array_equal(img2, img)  # png is lossless
+
+
+def test_pack_multi_label():
+    header = rio.IRHeader(0, [1.0, 2.0, 3.0], 7, 0)
+    s = rio.pack(header, b"payload")
+    h2, payload = rio.unpack(s)
+    assert h2.flag == 3
+    np.testing.assert_allclose(h2.label, [1, 2, 3])
+    assert payload == b"payload"
+
+
+def _make_rec_dataset(td, n=24, size=40):
+    """Synthetic labeled image dataset packed via the im2rec tool."""
+    rs = np.random.RandomState(1)
+    from PIL import Image
+    for cls in range(3):
+        d = os.path.join(td, "data", f"class{cls}")
+        os.makedirs(d, exist_ok=True)
+        for i in range(n // 3):
+            arr = np.full((size, size, 3), cls * 80, np.uint8) + \
+                (rs.rand(size, size, 3) * 40).astype(np.uint8)
+            Image.fromarray(arr).save(os.path.join(d, f"{i}.png"))
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "..", "tools"))
+    import im2rec
+    prefix = os.path.join(td, "ds")
+    im2rec.make_list(prefix, os.path.join(td, "data"))
+    im2rec.pack(prefix, os.path.join(td, "data"))
+    return prefix
+
+
+def test_im2rec_and_image_record_iter():
+    with tempfile.TemporaryDirectory() as td:
+        prefix = _make_rec_dataset(td)
+        assert os.path.exists(prefix + ".rec")
+        assert os.path.exists(prefix + ".idx")
+        it = mx.image.ImageRecordIter(
+            path_imgrec=prefix + ".rec", data_shape=(3, 32, 32),
+            batch_size=8, shuffle=True, rand_mirror=True,
+            preprocess_threads=2)
+        total, labels_seen = 0, set()
+        for batch in it:
+            assert batch.data[0].shape == (8, 3, 32, 32)
+            total += 8 - batch.pad
+            labels_seen.update(
+                batch.label[0].asnumpy()[:8 - batch.pad].tolist())
+        assert total == 24
+        assert labels_seen == {0.0, 1.0, 2.0}
+        # second epoch works (prefetcher restart)
+        it.reset()
+        assert sum(8 - b.pad for b in it) == 24
+
+
+def test_image_iter_from_list():
+    with tempfile.TemporaryDirectory() as td:
+        prefix = _make_rec_dataset(td)
+        it = mx.image.ImageIter(
+            batch_size=6, data_shape=(3, 32, 32),
+            path_imglist=prefix + ".lst",
+            path_root=os.path.join(td, "data"))
+        batch = it.next()
+        assert batch.data[0].shape == (6, 3, 32, 32)
+
+
+def test_augmenters():
+    rs = np.random.RandomState(2)
+    img = mx.nd.array((rs.rand(50, 40, 3) * 255).astype(np.uint8))
+    out = mx.image.resize_short(img, 30)
+    assert min(out.shape[:2]) == 30
+    crop, rect = mx.image.center_crop(img, (24, 24))
+    assert crop.shape == (24, 24, 3)
+    norm = mx.image.color_normalize(
+        crop, mean=[1, 2, 3], std=[2, 2, 2])
+    np.testing.assert_allclose(
+        norm.asnumpy(),
+        (crop.asnumpy().astype(np.float32) - [1, 2, 3]) / [2, 2, 2],
+        rtol=1e-5)
+
+
+def test_pack_flag_roundtrip_and_2d_labels():
+    # caller-set nonzero flag with scalar label must round-trip
+    h, payload = rio.unpack(rio.pack(rio.IRHeader(2, 5.0, 1, 0),
+                                     b"payload"))
+    assert h.label == 5.0 and payload == b"payload"
+    # 2-D labels flatten to size, not first-axis length
+    lab = np.arange(6, dtype=np.float32).reshape(2, 3)
+    h2, p2 = rio.unpack(rio.pack(rio.IRHeader(0, lab, 0, 0), b"x"))
+    assert h2.flag == 6
+    np.testing.assert_allclose(h2.label, lab.reshape(-1))
+    assert p2 == b"x"
+
+
+def test_record_iter_midepoch_reset():
+    """reset() mid-epoch must restart cleanly with no stale batches."""
+    with tempfile.TemporaryDirectory() as td:
+        prefix = _make_rec_dataset(td)
+        it = mx.image.ImageRecordIter(
+            path_imgrec=prefix + ".rec", data_shape=(3, 32, 32),
+            batch_size=8, preprocess_threads=2, prefetch_buffer=1)
+        it.next()  # consume one batch, producer mid-flight
+        it.reset()
+        assert sum(8 - b.pad for b in it) == 24
+
+
+def test_record_iter_corrupt_record_raises():
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "bad.rec")
+        w = rio.MXRecordIO(path, "w")
+        w.write(b"this is not an image")
+        w.close()
+        it = mx.image.ImageRecordIter(
+            path_imgrec=path, data_shape=(3, 8, 8), batch_size=1,
+            preprocess_threads=1)
+        with pytest.raises(Exception):
+            it.next()
+
+
+def test_record_iter_round_batch_wraps():
+    with tempfile.TemporaryDirectory() as td:
+        prefix = _make_rec_dataset(td)  # 24 records
+        it = mx.image.ImageRecordIter(
+            path_imgrec=prefix + ".rec", data_shape=(3, 32, 32),
+            batch_size=10, preprocess_threads=2, round_batch=True)
+        batches = list(it)
+        assert [b.pad for b in batches] == [0, 0, 6]
+        tail = batches[-1].data[0].asnumpy()
+        assert np.abs(tail[4:]).sum() > 0  # wrapped, not zero-padded

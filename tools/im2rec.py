@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""im2rec: pack an image folder (or .lst) into RecordIO shards (ref:
+tools/im2rec.py and the C++ tools/im2rec.cc — the packing core here is
+the native librecordio writer via incubator_mxnet_tpu.recordio).
+
+Usage:
+  python tools/im2rec.py PREFIX ROOT --list       # write PREFIX.lst
+  python tools/im2rec.py PREFIX ROOT              # pack PREFIX.rec/.idx
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), ".."))
+
+EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def make_list(prefix, root, recursive=True):
+    """Write PREFIX.lst: 'index\\tlabel\\trelpath' (one class per
+    subdirectory, ref: im2rec.py make_list)."""
+    entries = []
+    classes = sorted(
+        d for d in os.listdir(root)
+        if os.path.isdir(os.path.join(root, d))) if recursive else []
+    if classes:
+        for li, cls in enumerate(classes):
+            for dirpath, _, files in os.walk(os.path.join(root, cls)):
+                for fn in sorted(files):
+                    if fn.lower().endswith(EXTS):
+                        rel = os.path.relpath(
+                            os.path.join(dirpath, fn), root)
+                        entries.append((li, rel))
+    else:
+        for fn in sorted(os.listdir(root)):
+            if fn.lower().endswith(EXTS):
+                entries.append((0, fn))
+    with open(prefix + ".lst", "w") as f:
+        for i, (label, rel) in enumerate(entries):
+            f.write(f"{i}\t{float(label)}\t{rel}\n")
+    return prefix + ".lst"
+
+
+def pack(prefix, root, lst_path=None, quality=95, resize=0):
+    """Pack list entries into PREFIX.rec + PREFIX.idx."""
+    from incubator_mxnet_tpu import recordio as rio
+    from incubator_mxnet_tpu.image import resize_short
+    from incubator_mxnet_tpu.ndarray import array as nd_array
+    import numpy as np
+    from PIL import Image
+
+    lst_path = lst_path or prefix + ".lst"
+    rec = rio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    n = 0
+    with open(lst_path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            idx = int(parts[0])
+            labels = [float(x) for x in parts[1:-1]]
+            path = os.path.join(root, parts[-1])
+            img = np.asarray(Image.open(path).convert("RGB"))
+            if resize:
+                img = resize_short(nd_array(img), resize).asnumpy()
+            label = labels[0] if len(labels) == 1 else labels
+            header = rio.IRHeader(0, label, idx, 0)
+            rec.write_idx(idx, rio.pack_img(header, img,
+                                            quality=quality))
+            n += 1
+    rec.close()
+    return n
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("prefix")
+    ap.add_argument("root")
+    ap.add_argument("--list", action="store_true",
+                    help="generate the .lst only")
+    ap.add_argument("--quality", type=int, default=95)
+    ap.add_argument("--resize", type=int, default=0)
+    args = ap.parse_args()
+    if args.list:
+        path = make_list(args.prefix, args.root)
+        print(f"wrote {path}")
+    else:
+        if not os.path.exists(args.prefix + ".lst"):
+            make_list(args.prefix, args.root)
+        n = pack(args.prefix, args.root, quality=args.quality,
+                 resize=args.resize)
+        print(f"packed {n} records into {args.prefix}.rec")
+
+
+if __name__ == "__main__":
+    main()
