@@ -48,6 +48,11 @@ from . import gluon
 from . import rnn
 from . import recordio
 from . import image
+from . import operator
+from . import profiler
+from . import monitor
+from .monitor import Monitor
+from . import visualization
 from . import parallel
 
 __all__ = ["nd", "ndarray", "autograd", "Context", "cpu", "tpu", "gpu",

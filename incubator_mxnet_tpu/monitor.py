@@ -1,0 +1,105 @@
+"""Monitor: numeric debugging of per-op outputs (ref:
+python/mxnet/monitor.py Monitor:33; executor callback ref:
+src/executor/graph_executor.cc:121,1423).
+
+The reference streams every op's outputs through a stat function via
+the executor monitor callback.  Here the hook rides the imperative
+dispatch path (imperative_invoke), which covers eager NDArray code and
+non-hybridized Gluon — per-op visibility inside a compiled XLA
+executable doesn't exist by design (ops are fused away), matching the
+reference's own limitation that bulked segments skip the callback.
+"""
+import re
+
+__all__ = ["Monitor"]
+
+_active_monitor = None
+
+
+def _default_stat(x):
+    return float(abs(x).mean())
+
+
+class Monitor:
+    """Collect (batch, op_name, stat) rows while armed (ref:
+    monitor.py Monitor:33 — tic/toc/toc_print)."""
+
+    def __init__(self, interval=1, stat_func=None, pattern=".*",
+                 sort=False):
+        self.interval = interval
+        self.stat_func = stat_func or _default_stat
+        self.pattern = re.compile(pattern)
+        self.sort = sort
+        self.step = 0
+        self.activated = False
+        self.queue = []
+        self._exes = []
+
+    # ------------------------------------------------------------ install
+    def install(self, target=None):
+        """Arm the global dispatch hook; optionally also watch a
+        Module/Executor's outputs (compiled path)."""
+        global _active_monitor
+        _active_monitor = self
+        if target is not None:
+            self._exes.append(target)
+        return self
+
+    def uninstall(self):
+        global _active_monitor
+        if _active_monitor is self:
+            _active_monitor = None
+
+    # ------------------------------------------------------------ batch
+    def tic(self):
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        if not self.activated:
+            return []
+        self.activated = False
+        for exe in self._exes:
+            outputs = getattr(exe, "outputs", None) or []
+            names = []
+            sym = getattr(exe, "_symbol", None)
+            if sym is not None:
+                names = sym.list_outputs()
+            for i, o in enumerate(outputs):
+                name = names[i] if i < len(names) else f"output{i}"
+                if self.pattern.match(name):
+                    self.queue.append((self.step, name,
+                                       self.stat_func(o.asnumpy())))
+        res = self.queue
+        self.queue = []
+        if self.sort:
+            res = sorted(res, key=lambda r: r[1])
+        return res
+
+    def toc_print(self):
+        for step, name, stat in self.toc():
+            print(f"Batch: {step:7d} {name:30s} {stat}")
+
+    # ------------------------------------------------------------ hook
+    def _observe(self, name, out_arrays):
+        if not self.activated or not self.pattern.match(name):
+            return
+        for i, arr in enumerate(out_arrays):
+            label = name if len(out_arrays) == 1 else f"{name}_out{i}"
+            try:
+                self.queue.append((self.step, label,
+                                   self.stat_func(arr.asnumpy())))
+            except Exception:
+                pass  # non-numeric outputs
+
+
+def observe_op(name, out_arrays):
+    """Dispatch-path hook (called from imperative_invoke)."""
+    if _active_monitor is not None:
+        _active_monitor._observe(name, out_arrays)
+
+
+def active():
+    return _active_monitor is not None and _active_monitor.activated

@@ -488,6 +488,10 @@ class NDArray:
 
 def imperative_invoke(op, args, kwargs, out=None):
     """Execute a registered op on NDArrays; records for autograd."""
+    from .. import profiler as _prof_mod
+    _prof = _prof_mod._profiler if _prof_mod._profiler.running else None
+    if _prof is not None:
+        _prof.op_start()
     params = {k: v for k, v in kwargs.items()
               if v is not None and k not in ("name", "ctx")}
     ctx = kwargs.get("ctx")
@@ -548,6 +552,12 @@ def imperative_invoke(op, args, kwargs, out=None):
         # pass ALL fn outputs (incl. trailing aux) so the vjp closure's
         # cotangent structure matches; aux slots get zero cotangents
         make_node(op, vjp_fn, nd_inputs, all_outs, out_arrays, n_aux_out)
+
+    if _prof is not None:
+        _prof.record_op(op.name, outs_list)
+    from .. import monitor as _mon_mod
+    if _mon_mod.active():
+        _mon_mod.observe_op(op.name, out_arrays)
 
     if out is not None:
         targets = out if isinstance(out, (tuple, list)) else [out]

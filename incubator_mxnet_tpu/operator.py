@@ -1,0 +1,187 @@
+"""Custom operators defined in Python (ref: python/mxnet/operator.py
+CustomOp/CustomOpProp:96+, registered into the runtime via
+MXCustomOpRegister, src/c_api/c_api.cc:1157; executed on a dedicated
+thread by src/operator/custom/custom.cc).
+
+TPU-native execution: the user's numpy/NDArray code runs as a host
+callback (`jax.pure_callback`) embedded in the compiled graph, with a
+`jax.custom_vjp` wiring its backward — so a Custom op composes with
+jit, autograd, and the symbolic executor exactly like a built-in op,
+at the cost of a host round-trip (the same cost the reference paid
+crossing into the Python callback thread).
+"""
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .ops.registry import defop
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered"]
+
+_REGISTRY = {}
+
+
+class CustomOp:
+    """Base for user op implementations (ref: operator.py CustomOp)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad,
+                 aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """(ref: operator.py CustomOp.assign)"""
+        if req in ("null", 0):
+            return
+        if req in ("add", 3):
+            dst[:] = dst + src
+        else:
+            dst[:] = src
+
+
+class CustomOpProp:
+    """Describes a custom op (ref: operator.py CustomOpProp:96)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return (in_type,
+                [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        raise NotImplementedError
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+
+def register(reg_name):
+    """Decorator registering a CustomOpProp subclass under reg_name
+    (ref: operator.py register / MXCustomOpRegister)."""
+    def _reg(prop_cls):
+        _REGISTRY[reg_name] = prop_cls
+        return prop_cls
+    return _reg
+
+
+def get_all_registered():
+    return dict(_REGISTRY)
+
+
+def _nd_wrap(arrs):
+    from .ndarray.ndarray import NDArray
+    return [NDArray(jnp.asarray(a)) for a in arrs]
+
+
+def _build_custom_call(op_type, kwargs_tuple, in_shapes, in_dtypes,
+                       training):
+    """One traced-callable per (op_type, kwargs, signature)."""
+    prop = _REGISTRY[op_type](**dict(kwargs_tuple))
+    in_shapes2, out_shapes, _ = prop.infer_shape(
+        [list(s) for s in in_shapes])
+    ts, out_types, _ = prop.infer_type(list(in_dtypes))
+    del ts
+    op = prop.create_operator(None, in_shapes2, in_dtypes)
+    n_out = len(out_shapes)
+    out_avals = tuple(
+        jax.ShapeDtypeStruct(tuple(s), np.dtype(t))
+        for s, t in zip(out_shapes, out_types))
+    in_avals = tuple(
+        jax.ShapeDtypeStruct(tuple(s), np.dtype(t))
+        for s, t in zip(in_shapes, in_dtypes))
+
+    def host_forward(*xs):
+        from .ndarray.ndarray import zeros as nd_zeros
+        in_nd = _nd_wrap(xs)
+        out_nd = [nd_zeros(tuple(s), dtype=t)
+                  for s, t in zip(out_shapes, out_types)]
+        op.forward(training, ["write"] * n_out, in_nd, out_nd, [])
+        return tuple(o.asnumpy() for o in out_nd)
+
+    def host_backward(*xs):
+        from .ndarray.ndarray import zeros as nd_zeros
+        n_in = len(in_shapes)
+        grads = _nd_wrap(xs[:n_out])
+        ins = _nd_wrap(xs[n_out:n_out + n_in])
+        outs = _nd_wrap(xs[n_out + n_in:])
+        in_grad = [nd_zeros(tuple(s), dtype=t)
+                   for s, t in zip(in_shapes, in_dtypes)]
+        op.backward(["write"] * n_in, grads, ins, outs, in_grad, [])
+        return tuple(g.asnumpy() for g in in_grad)
+
+    @jax.custom_vjp
+    def call(*inputs):
+        return jax.pure_callback(host_forward, out_avals, *inputs)
+
+    def fwd(*inputs):
+        outs = jax.pure_callback(host_forward, out_avals, *inputs)
+        return outs, (inputs, outs)
+
+    def bwd(res, cts):
+        inputs, outs = res
+        in_grads = jax.pure_callback(host_backward, in_avals,
+                                     *(tuple(cts) + tuple(inputs)
+                                       + tuple(outs)))
+        return tuple(in_grads)
+
+    call.defvjp(fwd, bwd)
+    return call, n_out
+
+
+@functools.lru_cache(maxsize=256)
+def _cached_custom_call(op_type, kwargs_tuple, in_shapes, in_dtypes,
+                        training):
+    return _build_custom_call(op_type, kwargs_tuple, in_shapes,
+                              in_dtypes, training)
+
+
+def _n_outputs(params):
+    op_type = params.get("op_type")
+    if op_type in _REGISTRY:
+        return len(_REGISTRY[op_type]().list_outputs())
+    return 1
+
+
+@defop("Custom", variadic=True, needs_mode=True,
+       num_outputs=_n_outputs)
+def custom(*inputs, op_type=None, _training=False, **kwargs):
+    """Invoke a registered Python custom op (ref:
+    src/operator/custom/custom.cc)."""
+    if op_type not in _REGISTRY:
+        raise ValueError(
+            f"custom op '{op_type}' not registered; known: "
+            f"{sorted(_REGISTRY)}")
+    in_shapes = tuple(tuple(x.shape) for x in inputs)
+    in_dtypes = tuple(np.dtype(x.dtype).name for x in inputs)
+    kwargs_tuple = tuple(sorted(kwargs.items()))
+    call, n_out = _cached_custom_call(op_type, kwargs_tuple,
+                                      in_shapes, in_dtypes,
+                                      bool(_training))
+    outs = call(*inputs)
+    if n_out == 1:
+        return outs[0]
+    return tuple(outs)

@@ -1,0 +1,120 @@
+"""Profiler: per-op timing + chrome://tracing dump (ref:
+src/engine/profiler.h OprExecStat:39 / Profiler:80 / DumpProfile:107,
+python/mxnet/profiler.py, env vars MXNET_PROFILER_AUTOSTART).
+
+Two layers, mirroring the reference's split between its own op stats
+and nvprof:
+- framework layer: every imperative op dispatch is timed (optionally
+  synchronized for true kernel time, mode='sync') and dumped as
+  chrome://tracing JSON via dump_profile();
+- XLA layer: start_xla_trace/stop_xla_trace wrap jax.profiler for
+  TensorBoard/Perfetto-grade device traces.
+"""
+import json
+import os
+import threading
+import time
+
+__all__ = ["set_config", "set_state", "dump_profile", "pause",
+           "resume", "start_xla_trace", "stop_xla_trace", "Profiler"]
+
+
+class Profiler:
+    """Singleton collecting OprExecStat-style events."""
+
+    def __init__(self):
+        self.filename = "profile.json"
+        self.mode = "coarse"  # 'coarse' | 'sync' (block per op)
+        self.state = "stop"
+        self._events = []
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+
+    # ------------------------------------------------------------ api
+    def set_config(self, filename="profile.json", mode="coarse",
+                   **_ignored):
+        self.filename = filename
+        self.mode = mode
+
+    def set_state(self, state):
+        assert state in ("run", "stop")
+        self.state = state
+
+    @property
+    def running(self):
+        return self.state == "run"
+
+    def add_event(self, name, t_start, t_end, category="operator"):
+        with self._lock:
+            self._events.append({
+                "name": name, "cat": category, "ph": "X",
+                "ts": (t_start - self._t0) * 1e6,
+                "dur": (t_end - t_start) * 1e6,
+                "pid": os.getpid(), "tid": threading.get_ident(),
+            })
+
+    def dump(self, finished=True):
+        with self._lock:
+            data = {"traceEvents": list(self._events)}
+            if finished:
+                self._events = []
+        with open(self.filename, "w") as f:
+            json.dump(data, f)
+        return self.filename
+
+    # -------------------------------------------------- op dispatch hook
+    def record_op(self, name, outs):
+        """Called from imperative_invoke when running."""
+        if self.mode == "sync":
+            for o in outs:
+                try:
+                    o.block_until_ready()
+                except AttributeError:
+                    pass
+        self.add_event(name, self._pending_t0, time.perf_counter())
+
+    def op_start(self):
+        self._pending_t0 = time.perf_counter()
+
+
+_profiler = Profiler()
+
+
+def set_config(**kwargs):
+    """(ref: profiler.py profiler_set_config)"""
+    _profiler.set_config(**kwargs)
+
+
+def set_state(state="stop"):
+    """'run' or 'stop' (ref: profiler.py profiler_set_state)."""
+    _profiler.set_state(state)
+
+
+def pause():
+    _profiler.set_state("stop")
+
+
+def resume():
+    _profiler.set_state("run")
+
+
+def dump_profile():
+    """Write chrome://tracing JSON (ref: MXDumpProfile)."""
+    return _profiler.dump()
+
+
+def start_xla_trace(logdir="/tmp/xla_trace"):
+    """Device-level trace via the XLA profiler (TensorBoard-viewable)."""
+    import jax
+    jax.profiler.start_trace(logdir)
+    return logdir
+
+
+def stop_xla_trace():
+    import jax
+    jax.profiler.stop_trace()
+
+
+# autostart parity (ref: env var MXNET_PROFILER_AUTOSTART)
+if os.environ.get("MXTPU_PROFILER_AUTOSTART", "") == "1":
+    _profiler.set_state("run")

@@ -1,0 +1,137 @@
+"""Aux subsystems: profiler, monitor, custom ops, visualization
+(model: reference tests/python/unittest/test_profiler.py,
+test_operator.py CustomOp cases, test_viz.py)."""
+import json
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, autograd
+
+
+def test_profiler_collects_and_dumps():
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "prof.json")
+        mx.profiler.set_config(filename=path, mode="sync")
+        mx.profiler.set_state("run")
+        a = nd.ones((8, 8))
+        b = (a * 2 + 1).sum()
+        b.wait_to_read()
+        mx.profiler.set_state("stop")
+        out = mx.profiler.dump_profile()
+        assert out == path
+        with open(path) as f:
+            trace = json.load(f)
+        names = [e["name"] for e in trace["traceEvents"]]
+        assert len(names) >= 2
+        assert all(e["dur"] >= 0 for e in trace["traceEvents"])
+        assert any("sum" in n or "mul" in n or "plus" in n
+                   for n in names), names
+
+
+def test_monitor_observes_ops():
+    mon = mx.Monitor(interval=1, pattern=".*").install()
+    try:
+        mon.tic()
+        x = nd.ones((4, 4))
+        y = x * 3
+        y.wait_to_read()
+        rows = mon.toc()
+        assert rows, "monitor saw no ops"
+        assert any(abs(stat - 3.0) < 1e-6 for _, _, stat in rows)
+    finally:
+        mon.uninstall()
+
+
+@mx.operator.register("scale2")
+class Scale2Prop(mx.operator.CustomOpProp):
+    def __init__(self, factor=2.0):
+        super().__init__(need_top_grad=True)
+        self.factor = float(factor)
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        factor = self.factor
+
+        class Scale2(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                self.assign(out_data[0], req[0],
+                            in_data[0] * factor)
+
+            def backward(self, req, out_grad, in_data, out_data,
+                         in_grad, aux):
+                self.assign(in_grad[0], req[0],
+                            out_grad[0] * factor)
+        return Scale2()
+
+
+def test_custom_op_forward_backward():
+    x = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    y = nd.Custom(x, op_type="scale2", factor=3.0)
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy() * 3.0)
+    x.attach_grad()
+    with autograd.record():
+        z = nd.Custom(x, op_type="scale2", factor=3.0).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               np.full((2, 3), 3.0))
+
+
+def test_custom_op_in_hybrid_jit():
+    """Custom ops must survive jit (pure_callback path)."""
+    import jax
+
+    @jax.jit
+    def f(v):
+        from incubator_mxnet_tpu.operator import custom
+        return custom(v, op_type="scale2", factor=4.0)
+
+    import jax.numpy as jnp
+    out = f(jnp.ones((3,)))
+    np.testing.assert_allclose(np.asarray(out), np.full((3,), 4.0))
+
+
+def test_custom_op_in_symbol_executor():
+    data = mx.sym.Variable("data")
+    out = mx.sym.Custom(data, op_type="scale2", factor=5.0,
+                        name="my_custom")
+    exe = out.simple_bind(None, data=(2, 2))
+    res = exe.forward(is_train=False, data=nd.ones((2, 2)))
+    np.testing.assert_allclose(res[0].asnumpy(),
+                               np.full((2, 2), 5.0))
+
+
+def test_print_summary():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=4, name="fc2")
+    out = mx.sym.SoftmaxOutput(fc2, name="softmax")
+    total = mx.visualization.print_summary(
+        out, shape={"data": (8, 10)})
+    # fc1: 10*16+16, fc2: 16*4+4
+    assert total == 10 * 16 + 16 + 16 * 4 + 4
+
+
+def test_autograd_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = 1 / (1 + nd.exp(-x))
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            y, = self.saved_tensors
+            return dy * y * (1 - y)
+
+    x = nd.array(np.array([0.0, 1.0, -2.0], np.float32))
+    x.attach_grad()
+    f = Sigmoid()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    sig = 1 / (1 + np.exp(-x.asnumpy()))
+    np.testing.assert_allclose(x.grad.asnumpy(), sig * (1 - sig),
+                               rtol=1e-5)
