@@ -43,8 +43,20 @@ def _to_np(img):
 
 
 def imresize(src, w, h, interp=2):
+    """Bilinear resize preserving the input dtype (float pixel data
+    from CastAug/ColorNormalizeAug must not be truncated to uint8)."""
     from PIL import Image
-    arr = _to_np(src).astype(np.uint8)
+    arr = _to_np(src)
+    if np.issubdtype(arr.dtype, np.floating):
+        in_dtype = arr.dtype
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        chans = [np.asarray(
+            Image.fromarray(arr[:, :, c].astype(np.float32), mode="F")
+            .resize((w, h), Image.BILINEAR))
+            for c in range(arr.shape[-1])]
+        return nd_array(np.stack(chans, axis=-1).astype(in_dtype))
+    arr = arr.astype(np.uint8)
     pil = Image.fromarray(arr.squeeze() if arr.shape[-1] == 1 else arr)
     out = np.asarray(pil.resize((w, h), Image.BILINEAR))
     if out.ndim == 2:

@@ -162,7 +162,11 @@ def _cached_custom_call(op_type, kwargs_tuple, in_shapes, in_dtypes,
 def _n_outputs(params):
     op_type = params.get("op_type")
     if op_type in _REGISTRY:
-        return len(_REGISTRY[op_type]().list_outputs())
+        # construct the prop with the op's own kwargs — list_outputs()
+        # may depend on them (mirrors _build_custom_call)
+        kwargs = {k: v for k, v in params.items()
+                  if k != "op_type" and not k.startswith("_")}
+        return len(_REGISTRY[op_type](**kwargs).list_outputs())
     return 1
 
 

@@ -141,6 +141,10 @@ def rnn(*args, state_size=0, num_layers=1, mode="lstm",
     """Fused multi-layer RNN (ref: src/operator/rnn-inl.h RNNParam)."""
     data, flat = args[0], args[1]
     state = args[2]
+    if mode == "lstm" and len(args) < 4:
+        raise ValueError(
+            "RNN(mode='lstm') requires a state_cell input "
+            "(data, parameters, state, state_cell)")
     state_cell = args[3] if mode == "lstm" and len(args) > 3 else None
     T, N, C = data.shape
     H = int(state_size)

@@ -34,11 +34,20 @@ __all__ = ["ShardedTrainStep"]
 
 def _default_loss(outputs, labels):
     """Softmax cross-entropy on logits (config-1/2 default)."""
-    logits = outputs[0]
+    logits = outputs[0].astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=-1)
     onehot = jax.nn.one_hot(labels, logits.shape[-1],
                             dtype=logits.dtype)
     return -jnp.mean(jnp.sum(onehot * logp, axis=-1))
+
+
+def _cast_floats(tree, dtype):
+    """Cast float leaves of a pytree to ``dtype`` (ints untouched)."""
+    def cast(v):
+        if jnp.issubdtype(v.dtype, jnp.floating):
+            return v.astype(dtype)
+        return v
+    return jax.tree_util.tree_map(cast, tree)
 
 
 class ShardedTrainStep:
@@ -54,11 +63,17 @@ class ShardedTrainStep:
     batch_axis / seq_axis : which input dims shard over 'dp' / 'sp'
     donate : donate param/state buffers (in-place update, the XLA
         analog of the reference's in-place optimizer kernels)
+    compute_dtype : if set (e.g. jnp.bfloat16), the forward+backward
+        runs in this dtype while fp32 master params receive the
+        update — the reference's multi_precision / mp_sgd path (ref:
+        src/operator/optimizer_op.cc MP_SGD), laid out TPU-style so
+        the MXU sees bf16 operands.
     """
 
     def __init__(self, block, optimizer="sgd", optimizer_params=None,
                  mesh=None, loss_fn=None, rules=None, batch_axis=0,
-                 seq_axis=None, donate=True, example_args=None):
+                 seq_axis=None, donate=True, example_args=None,
+                 compute_dtype=None):
         if mesh is None:
             mesh = current_mesh()  # ambient mesh from use_mesh(...)
         self.mesh = mesh if mesh is not None else make_mesh()
@@ -77,6 +92,7 @@ class ShardedTrainStep:
         self.batch_axis = batch_axis
         self.seq_axis = seq_axis
         self._donate = donate
+        self.compute_dtype = compute_dtype
 
         # -- lay out current values over the mesh --------------------
         pvals = self.pure.params()
@@ -101,10 +117,15 @@ class ShardedTrainStep:
 
     def _build(self, x, y):
         pure, loss_fn, opt = self.pure, self.loss_fn, self.opt
+        cdt = self.compute_dtype
 
         def step(params, states, opt_state, x, y, rng):
             def lossf(p):
-                outs, new_states = pure.apply(p, states, [x], rng,
+                xin = x
+                if cdt is not None:
+                    p = _cast_floats(p, cdt)
+                    xin = _cast_floats(x, cdt)
+                outs, new_states = pure.apply(p, states, [xin], rng,
                                               training=True)
                 return loss_fn(outs, y), new_states
 

@@ -29,6 +29,7 @@ class Profiler:
         self._events = []
         self._lock = threading.Lock()
         self._t0 = time.perf_counter()
+        self._tls = threading.local()
 
     # ------------------------------------------------------------ api
     def set_config(self, filename="profile.json", mode="coarse",
@@ -71,10 +72,14 @@ class Profiler:
                     o.block_until_ready()
                 except AttributeError:
                     pass
-        self.add_event(name, self._pending_t0, time.perf_counter())
+        t0 = getattr(self._tls, "pending_t0", None)
+        self.add_event(name, t0 if t0 is not None
+                       else time.perf_counter(), time.perf_counter())
 
     def op_start(self):
-        self._pending_t0 = time.perf_counter()
+        # per-thread start time: ops dispatched concurrently from the
+        # threaded data pipeline must not cross-read each other's t0
+        self._tls.pending_t0 = time.perf_counter()
 
 
 _profiler = Profiler()
