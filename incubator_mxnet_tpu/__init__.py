@@ -54,6 +54,7 @@ from . import monitor
 from .monitor import Monitor
 from . import visualization
 from . import parallel
+from . import contrib
 
 __all__ = ["nd", "ndarray", "autograd", "Context", "cpu", "tpu", "gpu",
            "random", "NDArray", "TShape", "sym", "symbol", "Symbol",

@@ -1,0 +1,39 @@
+"""Contrib namespace: experimental / detection operators.
+
+Mirrors the reference's ``mx.contrib.ndarray`` / ``mx.contrib.symbol``
+surface (ref: python/mxnet/contrib/__init__.py), which exposes the
+``_contrib_*`` registry entries without their prefix, e.g.
+``mx.contrib.nd.MultiBoxPrior``.
+"""
+import types as _types
+
+from ..ops.registry import OPS as _OPS
+
+__all__ = ["ndarray", "nd", "symbol", "sym"]
+
+
+def _make_namespace(modname, lookup):
+    m = _types.ModuleType(modname)
+    for name, op in list(_OPS.items()):
+        if name.startswith("_contrib_"):
+            short = name[len("_contrib_"):]
+            fn = lookup(name)
+            if fn is not None:
+                setattr(m, short, fn)
+    return m
+
+
+def _nd_lookup(name):
+    from .. import ndarray as _nd
+    return getattr(_nd._internal, name, None)
+
+
+def _sym_lookup(name):
+    from .. import symbol as _sym
+    return getattr(_sym._internal, name, None)
+
+
+ndarray = _make_namespace(__name__ + ".ndarray", _nd_lookup)
+nd = ndarray
+symbol = _make_namespace(__name__ + ".symbol", _sym_lookup)
+sym = symbol

@@ -1,0 +1,474 @@
+"""Detection/contrib op family vs numpy oracles (ref semantics:
+src/operator/contrib/multibox_*.cc, roi_pooling.cc, proposal.cc,
+psroi_pooling.cu, deformable_convolution-inl.h)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+
+
+def _np_iou(a, b):
+    tlx = np.maximum(a[0], b[0]); tly = np.maximum(a[1], b[1])
+    brx = np.minimum(a[2], b[2]); bry = np.minimum(a[3], b[3])
+    i = max(brx - tlx, 0.0) * max(bry - tly, 0.0)
+    u = ((a[2] - a[0]) * (a[3] - a[1])
+         + (b[2] - b[0]) * (b[3] - b[1]) - i)
+    return 0.0 if u <= 0 else i / u
+
+
+# ---------------------------------------------------------------- prior
+def np_multibox_prior(h, w, sizes, ratios, clip, steps, offsets):
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+    out = []
+    for r in range(h):
+        cy = (r + offsets[0]) * step_y
+        for c in range(w):
+            cx = (c + offsets[1]) * step_x
+            for s in sizes:
+                ww = s * h / w / 2; hh = s / 2
+                out.append([cx - ww, cy - hh, cx + ww, cy + hh])
+            for j in range(1, len(ratios)):
+                rt = np.sqrt(ratios[j])
+                ww = sizes[0] * h / w * rt / 2
+                hh = sizes[0] / rt / 2
+                out.append([cx - ww, cy - hh, cx + ww, cy + hh])
+    out = np.array(out, np.float32)
+    if clip:
+        out = np.clip(out, 0, 1)
+    return out[None]
+
+
+@pytest.mark.parametrize("sizes,ratios,clip,steps", [
+    ((0.5,), (1.0,), False, (-1.0, -1.0)),
+    ((0.3, 0.6), (1.0, 2.0, 0.5), True, (-1.0, -1.0)),
+    ((0.4,), (1.0, 3.0), False, (0.1, 0.2)),
+])
+def test_multibox_prior(sizes, ratios, clip, steps):
+    x = nd.zeros((2, 8, 5, 7))
+    out = nd._internal._contrib_MultiBoxPrior(
+        x, sizes=sizes, ratios=ratios, clip=clip, steps=steps)
+    ref = np_multibox_prior(5, 7, sizes, ratios, clip, steps, (0.5, 0.5))
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5, atol=1e-6)
+
+
+def test_contrib_namespace():
+    x = nd.zeros((1, 3, 4, 4))
+    out = mx.contrib.nd.MultiBoxPrior(x, sizes=(0.5,))
+    assert out.shape == (1, 16, 4)
+
+
+# ---------------------------------------------------------------- target
+def np_multibox_target(anchors, labels, cls_preds, overlap_threshold,
+                       ignore_label, neg_ratio, neg_thresh, variances):
+    """Literal port of MultiBoxTargetForward (multibox_target.cc)."""
+    B, L, LW = labels.shape
+    A = anchors.shape[0]
+    loc_t = np.zeros((B, A * 4), np.float32)
+    loc_m = np.zeros((B, A * 4), np.float32)
+    cls_t = np.full((B, A), ignore_label, np.float32)
+    for b in range(B):
+        lab = labels[b]
+        nv = 0
+        for i in range(L):
+            if lab[i, 0] == -1:
+                break
+            nv += 1
+        if nv == 0:
+            continue
+        ious = np.zeros((A, nv))
+        for j in range(A):
+            for k in range(nv):
+                ious[j, k] = _np_iou(anchors[j], lab[k, 1:5])
+        gt_flags = [False] * nv
+        anchor_flags = [-1] * A
+        matches = [(-1.0, -1)] * A
+        while not all(gt_flags):
+            best_a = best_g = -1
+            best = 1e-6
+            for j in range(A):
+                if anchor_flags[j] == 1:
+                    continue
+                for k in range(nv):
+                    if gt_flags[k]:
+                        continue
+                    if ious[j, k] > best:
+                        best, best_a, best_g = ious[j, k], j, k
+            if best_a == -1:
+                break
+            matches[best_a] = (best, best_g)
+            gt_flags[best_g] = True
+            anchor_flags[best_a] = 1
+        if overlap_threshold > 0:
+            for j in range(A):
+                if anchor_flags[j] == 1:
+                    continue
+                bg, bi = -1, -1.0
+                for k in range(nv):
+                    if ious[j, k] > bi:
+                        bi, bg = ious[j, k], k
+                if bg != -1:
+                    matches[j] = (bi, bg)
+                    if bi > overlap_threshold:
+                        anchor_flags[j] = 1
+        num_pos = sum(1 for f in anchor_flags if f == 1)
+        if neg_ratio > 0:
+            num_neg = min(int(num_pos * neg_ratio), A - num_pos)
+            if num_neg > 0:
+                cand = []
+                for j in range(A):
+                    if anchor_flags[j] == 1:
+                        continue
+                    if matches[j][0] < neg_thresh and anchor_flags[j] == -1:
+                        p = cls_preds[b, :, j]
+                        e = np.exp(p - p.max())
+                        prob = e[0] / e.sum()
+                        cand.append((-prob, j))
+                # SortElemDescend on value=-prob: descending -prob ==
+                # ascending background prob (hardest negatives first)
+                cand.sort(key=lambda t: -t[0])
+                for _, j in cand[:num_neg]:
+                    anchor_flags[j] = 0
+        else:
+            for j in range(A):
+                if anchor_flags[j] != 1:
+                    anchor_flags[j] = 0
+        for i in range(A):
+            if anchor_flags[i] == 1:
+                g = matches[i][1]
+                cls_t[b, i] = lab[g, 0] + 1
+                loc_m[b, i * 4:i * 4 + 4] = 1
+                al, at, ar, ab_ = anchors[i]
+                aw, ah = ar - al, ab_ - at
+                ax, ay = (al + ar) / 2, (at + ab_) / 2
+                gl, gt_, gr, gb = lab[g, 1:5]
+                gw, gh = gr - gl, gb - gt_
+                gx, gy = (gl + gr) / 2, (gt_ + gb) / 2
+                loc_t[b, i * 4 + 0] = (gx - ax) / aw / variances[0]
+                loc_t[b, i * 4 + 1] = (gy - ay) / ah / variances[1]
+                loc_t[b, i * 4 + 2] = np.log(gw / aw) / variances[2]
+                loc_t[b, i * 4 + 3] = np.log(gh / ah) / variances[3]
+            elif anchor_flags[i] == 0:
+                cls_t[b, i] = 0
+    return loc_t, loc_m, cls_t
+
+
+def _target_fixture(seed=0, B=2, L=4, A=20, C=3):
+    rs = np.random.RandomState(seed)
+    anchors = np.zeros((A, 4), np.float32)
+    ctr = rs.rand(A, 2) * 0.8 + 0.1
+    wh = rs.rand(A, 2) * 0.3 + 0.05
+    anchors[:, :2] = ctr - wh / 2
+    anchors[:, 2:] = ctr + wh / 2
+    labels = -np.ones((B, L, 5), np.float32)
+    for b in range(B):
+        n = rs.randint(1, L)
+        for i in range(n):
+            c = rs.randint(0, C - 1)
+            x1, y1 = rs.rand(2) * 0.5
+            w, h = rs.rand(2) * 0.4 + 0.1
+            labels[b, i] = [c, x1, y1, x1 + w, y1 + h]
+    cls_preds = rs.randn(B, C, A).astype(np.float32)
+    return anchors, labels, cls_preds
+
+
+@pytest.mark.parametrize("neg_ratio", [-1.0, 2.0])
+def test_multibox_target(neg_ratio):
+    anchors, labels, cls_preds = _target_fixture()
+    var = (0.1, 0.1, 0.2, 0.2)
+    outs = nd._internal._contrib_MultiBoxTarget(
+        nd.array(anchors[None]), nd.array(labels), nd.array(cls_preds),
+        overlap_threshold=0.5, negative_mining_ratio=neg_ratio,
+        negative_mining_thresh=0.5, variances=var)
+    ref = np_multibox_target(anchors, labels, cls_preds, 0.5, -1.0,
+                             neg_ratio, 0.5, var)
+    for got, want in zip(outs, ref):
+        np.testing.assert_allclose(got.asnumpy(), want, rtol=1e-4,
+                                   atol=1e-5)
+
+
+def test_multibox_target_no_gt():
+    anchors, labels, cls_preds = _target_fixture()
+    labels[:] = -1
+    loc_t, loc_m, cls_t = nd._internal._contrib_MultiBoxTarget(
+        nd.array(anchors[None]), nd.array(labels), nd.array(cls_preds))
+    assert np.all(loc_t.asnumpy() == 0)
+    assert np.all(loc_m.asnumpy() == 0)
+    assert np.all(cls_t.asnumpy() == -1)
+
+
+# ------------------------------------------------------------- detection
+def np_multibox_detection(cls_prob, loc_pred, anchors, threshold,
+                          clip, variances, nms_threshold,
+                          force_suppress, nms_topk):
+    """Literal port of MultiBoxDetectionForward."""
+    B, C, A = cls_prob.shape
+    out = -np.ones((B, A, 6), np.float32)
+    for b in range(B):
+        rows = []
+        for i in range(A):
+            score, cid = -1.0, 0
+            for j in range(1, C):
+                if cls_prob[b, j, i] > score:
+                    score, cid = cls_prob[b, j, i], j
+            if cid > 0 and score < threshold:
+                cid = 0
+            if cid > 0:
+                al, at, ar, ab_ = anchors[i]
+                aw, ah = ar - al, ab_ - at
+                ax, ay = (al + ar) / 2, (at + ab_) / 2
+                p = loc_pred[b, i * 4:i * 4 + 4]
+                ox = p[0] * variances[0] * aw + ax
+                oy = p[1] * variances[1] * ah + ay
+                ow = np.exp(p[2] * variances[2]) * aw / 2
+                oh = np.exp(p[3] * variances[3]) * ah / 2
+                box = [ox - ow, oy - oh, ox + ow, oy + oh]
+                if clip:
+                    box = [min(max(v, 0.0), 1.0) for v in box]
+                rows.append([cid - 1.0, score] + box)
+        rows.sort(key=lambda r: -r[1])
+        nkeep = len(rows) if nms_topk <= 0 else min(nms_topk, len(rows))
+        rows = rows[:nkeep]
+        for i in range(len(rows)):
+            if rows[i][0] < 0:
+                continue
+            for j in range(i + 1, len(rows)):
+                if rows[j][0] < 0:
+                    continue
+                if force_suppress or rows[i][0] == rows[j][0]:
+                    iou = _np_iou(rows[i][2:], rows[j][2:])
+                    if iou >= nms_threshold:
+                        rows[j][0] = -1
+        for i, r in enumerate(rows):
+            out[b, i] = r
+    return out
+
+
+@pytest.mark.parametrize("force", [False, True])
+def test_multibox_detection(force):
+    rs = np.random.RandomState(3)
+    B, C, A = 2, 4, 12
+    anchors, _, _ = _target_fixture(A=A)
+    cls_prob = rs.rand(B, C, A).astype(np.float32)
+    cls_prob /= cls_prob.sum(axis=1, keepdims=True)
+    loc_pred = (rs.randn(B, A * 4) * 0.3).astype(np.float32)
+    var = (0.1, 0.1, 0.2, 0.2)
+    got = nd._internal._contrib_MultiBoxDetection(
+        nd.array(cls_prob), nd.array(loc_pred), nd.array(anchors[None]),
+        threshold=0.1, nms_threshold=0.45, force_suppress=force,
+        variances=var).asnumpy()
+    want = np_multibox_detection(cls_prob, loc_pred, anchors, 0.1, True,
+                                 var, 0.45, force, -1)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_multibox_detection_topk():
+    """nms_topk windows the NMS; rows past the window are suppressed."""
+    rs = np.random.RandomState(31)
+    B, C, A = 1, 3, 10
+    anchors, _, _ = _target_fixture(A=A)
+    cls_prob = rs.rand(B, C, A).astype(np.float32)
+    cls_prob /= cls_prob.sum(axis=1, keepdims=True)
+    loc_pred = (rs.randn(B, A * 4) * 0.2).astype(np.float32)
+    got = nd._internal._contrib_MultiBoxDetection(
+        nd.array(cls_prob), nd.array(loc_pred), nd.array(anchors[None]),
+        threshold=0.1, nms_threshold=0.45, nms_topk=4).asnumpy()
+    want = np_multibox_detection(cls_prob, loc_pred, anchors, 0.1, True,
+                                 (0.1, 0.1, 0.2, 0.2), 0.45, False, 4)
+    # rows 0..3 must match the oracle exactly; later rows suppressed
+    np.testing.assert_allclose(got[:, :4], want[:, :4], rtol=1e-4,
+                               atol=1e-5)
+    assert np.all(got[:, 4:, 0] == -1)
+
+
+# ------------------------------------------------------------ roipooling
+def np_roi_pooling(data, rois, pooled, scale):
+    R = rois.shape[0]
+    B, C, H, W = data.shape
+    ph, pw = pooled
+    out = np.zeros((R, C, ph, pw), np.float32)
+    for n in range(R):
+        b = int(rois[n, 0])
+        x1 = int(round(rois[n, 1] * scale))
+        y1 = int(round(rois[n, 2] * scale))
+        x2 = int(round(rois[n, 3] * scale))
+        y2 = int(round(rois[n, 4] * scale))
+        rh, rw = max(y2 - y1 + 1, 1), max(x2 - x1 + 1, 1)
+        bh, bw = rh / ph, rw / pw
+        for c in range(C):
+            for i in range(ph):
+                for j in range(pw):
+                    hs = min(max(int(np.floor(i * bh)) + y1, 0), H)
+                    he = min(max(int(np.ceil((i + 1) * bh)) + y1, 0), H)
+                    ws = min(max(int(np.floor(j * bw)) + x1, 0), W)
+                    we = min(max(int(np.ceil((j + 1) * bw)) + x1, 0), W)
+                    if he <= hs or we <= ws:
+                        out[n, c, i, j] = 0
+                    else:
+                        out[n, c, i, j] = data[b, c, hs:he, ws:we].max()
+    return out
+
+
+def test_roi_pooling():
+    rs = np.random.RandomState(5)
+    data = rs.randn(2, 3, 12, 16).astype(np.float32)
+    rois = np.array([[0, 0, 0, 7, 5], [1, 2, 2, 15, 11],
+                     [0, 4, 1, 6, 3], [1, 13, 9, 15, 11]], np.float32)
+    got = nd.ROIPooling(nd.array(data), nd.array(rois),
+                        pooled_size=(3, 3), spatial_scale=1.0).asnumpy()
+    want = np_roi_pooling(data, rois, (3, 3), 1.0)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_roi_pooling_scale_and_grad():
+    import jax
+    rs = np.random.RandomState(7)
+    data = rs.randn(1, 2, 8, 8).astype(np.float32)
+    rois = np.array([[0, 0, 0, 15, 15]], np.float32)
+    got = nd.ROIPooling(nd.array(data), nd.array(rois),
+                        pooled_size=(2, 2), spatial_scale=0.5).asnumpy()
+    want = np_roi_pooling(data, rois, (2, 2), 0.5)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    # gradient flows to max positions
+    from incubator_mxnet_tpu import autograd
+    x = nd.array(data)
+    x.attach_grad()
+    with autograd.record():
+        y = nd.ROIPooling(x, nd.array(rois), pooled_size=(2, 2),
+                          spatial_scale=0.5)
+    y.backward()
+    g = x.grad.asnumpy()
+    assert g.sum() > 0  # some gradient reached the input
+    assert (g != 0).sum() <= 2 * 4  # at most one position per bin
+
+
+# ---------------------------------------------------------- psroipooling
+def np_psroi_pooling(data, rois, scale, od, p, g):
+    B, C, H, W = data.shape
+    R = rois.shape[0]
+    out = np.zeros((R, od, p, p), np.float32)
+    for n in range(R):
+        b = int(rois[n, 0])
+        x1 = round(rois[n, 1]) * scale
+        y1 = round(rois[n, 2]) * scale
+        x2 = (round(rois[n, 3]) + 1) * scale
+        y2 = (round(rois[n, 4]) + 1) * scale
+        rw = max(x2 - x1, 0.1); rh = max(y2 - y1, 0.1)
+        bh, bw = rh / p, rw / p
+        for ct in range(od):
+            for i in range(p):
+                for j in range(p):
+                    hs = min(max(int(np.floor(i * bh + y1)), 0), H)
+                    he = min(max(int(np.ceil((i + 1) * bh + y1)), 0), H)
+                    ws = min(max(int(np.floor(j * bw + x1)), 0), W)
+                    we = min(max(int(np.ceil((j + 1) * bw + x1)), 0), W)
+                    gh = min(max(i * g // p, 0), g - 1)
+                    gw = min(max(j * g // p, 0), g - 1)
+                    c = (ct * g + gh) * g + gw
+                    if he <= hs or we <= ws:
+                        continue
+                    region = data[b, c, hs:he, ws:we]
+                    out[n, ct, i, j] = region.sum() / region.size
+    return out
+
+
+def test_psroi_pooling():
+    rs = np.random.RandomState(11)
+    od, g = 2, 3
+    data = rs.randn(2, od * g * g, 9, 9).astype(np.float32)
+    rois = np.array([[0, 1, 1, 6, 6], [1, 0, 2, 8, 7]], np.float32)
+    got = nd._internal._contrib_PSROIPooling(
+        nd.array(data), nd.array(rois), spatial_scale=1.0,
+        output_dim=od, pooled_size=g, group_size=g).asnumpy()
+    want = np_psroi_pooling(data, rois, 1.0, od, g, g)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+# -------------------------------------------------------------- proposal
+def test_proposal_shapes_and_validity():
+    rs = np.random.RandomState(13)
+    K = 6  # 2 ratios x 3 scales
+    H = W = 8
+    cls_prob = rs.rand(1, 2 * K, H, W).astype(np.float32)
+    bbox_pred = (rs.randn(1, 4 * K, H, W) * 0.1).astype(np.float32)
+    im_info = np.array([[128.0, 128.0, 1.0]], np.float32)
+    rois, scores = nd._internal._contrib_Proposal(
+        nd.array(cls_prob), nd.array(bbox_pred), nd.array(im_info),
+        rpn_pre_nms_top_n=50, rpn_post_nms_top_n=16, threshold=0.7,
+        rpn_min_size=4, scales=(8.0, 16.0), ratios=(0.5, 1.0, 2.0),
+        feature_stride=16, output_score=True)
+    r = rois.asnumpy()
+    assert r.shape == (16, 5)
+    assert np.all(r[:, 0] == 0)
+    assert np.all(r[:, 1] >= 0) and np.all(r[:, 3] <= 127.0)
+    assert np.all(r[:, 1] <= r[:, 3]) and np.all(r[:, 2] <= r[:, 4])
+    assert scores.asnumpy().shape == (16, 1)
+
+
+def test_multi_proposal_batch():
+    rs = np.random.RandomState(17)
+    K, H, W, B = 3, 6, 6, 2
+    cls_prob = rs.rand(B, 2 * K, H, W).astype(np.float32)
+    bbox_pred = (rs.randn(B, 4 * K, H, W) * 0.1).astype(np.float32)
+    im_info = np.array([[96.0, 96.0, 1.0]] * B, np.float32)
+    rois = nd._internal._contrib_MultiProposal(
+        nd.array(cls_prob), nd.array(bbox_pred), nd.array(im_info),
+        rpn_pre_nms_top_n=30, rpn_post_nms_top_n=8,
+        scales=(8.0,), ratios=(0.5, 1.0, 2.0)).asnumpy()
+    assert rois.shape == (B * 8, 5)
+    assert np.all(rois[:8, 0] == 0) and np.all(rois[8:, 0] == 1)
+
+
+# ------------------------------------------------- deformable convolution
+def test_deformable_conv_zero_offset_matches_conv():
+    """With zero offsets, deformable conv == standard convolution."""
+    rs = np.random.RandomState(19)
+    B, C, H, W, O, k = 2, 4, 7, 7, 6, 3
+    data = rs.randn(B, C, H, W).astype(np.float32)
+    weight = (rs.randn(O, C, k, k) * 0.2).astype(np.float32)
+    Ho = Wo = 7  # pad 1 stride 1
+    offset = np.zeros((B, 2 * k * k, Ho, Wo), np.float32)
+    got = nd._internal._contrib_DeformableConvolution(
+        nd.array(data), nd.array(offset), nd.array(weight),
+        kernel=(k, k), pad=(1, 1), num_filter=O, no_bias=True).asnumpy()
+    want = nd.Convolution(nd.array(data), nd.array(weight),
+                          kernel=(k, k), pad=(1, 1), num_filter=O,
+                          no_bias=True).asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_conv_integer_shift():
+    """Offset of exactly +1 in x equals convolving the shifted image."""
+    rs = np.random.RandomState(23)
+    B, C, H, W, O, k = 1, 2, 6, 6, 3, 1
+    data = rs.randn(B, C, H, W).astype(np.float32)
+    weight = rs.randn(O, C, 1, 1).astype(np.float32)
+    offset = np.zeros((B, 2, H, W), np.float32)
+    offset[:, 1] = 1.0  # shift +1 in x
+    got = nd._internal._contrib_DeformableConvolution(
+        nd.array(data), nd.array(offset), nd.array(weight),
+        kernel=(1, 1), num_filter=O, no_bias=True).asnumpy()
+    shifted = np.zeros_like(data)
+    shifted[..., :-1] = data[..., 1:]
+    want = np.einsum("oc,bchw->bohw", weight[:, :, 0, 0], shifted)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_deformable_conv_grad():
+    from incubator_mxnet_tpu import autograd
+    rs = np.random.RandomState(29)
+    data = nd.array(rs.randn(1, 2, 5, 5).astype(np.float32))
+    offset = nd.array((rs.randn(1, 2 * 9, 5, 5) * 0.1).astype(np.float32))
+    weight = nd.array((rs.randn(4, 2, 3, 3) * 0.2).astype(np.float32))
+    for v in (data, offset, weight):
+        v.attach_grad()
+    with autograd.record():
+        out = nd._internal._contrib_DeformableConvolution(
+            data, offset, weight, kernel=(3, 3), pad=(1, 1),
+            num_filter=4, no_bias=True)
+        loss = (out * out).sum()
+    loss.backward()
+    for v in (data, offset, weight):
+        assert np.isfinite(v.grad.asnumpy()).all()
+        assert np.abs(v.grad.asnumpy()).sum() > 0
