@@ -152,7 +152,9 @@ class CTCLoss(Loss):
         if self._label_layout == "TN":
             label = F.swapaxes(label, dim1=0, dim2=1)
         args = [pred, label]
-        kw = {}
+        # gluon convention (ref: gluon/loss.py:439-446): labels are
+        # classes 0..C-2 padded with -1, blank is the LAST channel
+        kw = {"blank_label": "last"}
         if pred_lengths is not None:
             kw["use_data_lengths"] = True
             args.append(pred_lengths)

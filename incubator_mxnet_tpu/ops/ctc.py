@@ -1,0 +1,116 @@
+"""CTC loss, TPU-first (ref: src/operator/contrib/ctc_loss.cc, which
+wraps warp-ctc; same conventions, different machinery).
+
+The reference runs warp-ctc's hand-written alpha/beta kernels; here the
+log-semiring alpha recursion is a `lax.scan` over time with masking for
+variable data/label lengths, and the exact gradient (softmax minus
+alignment posterior) comes out of `jax.grad` through the scan — no
+hand-written backward needed.
+
+Conventions (ref docstring ctc_loss.cc:72-105):
+- data (T, B, C) unnormalized activations; softmax applied internally
+- label (B, L) int; blank channel 0 when blank_label='first' (padding
+  value 0), channel C-1 when 'last' (padding value -1)
+- optional data_lengths (B,) / label_lengths (B,) inputs gated by
+  use_data_lengths / use_label_lengths
+- out (B,) positive costs -log p(label | data)
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import defop
+
+NEG = -1e30  # -inf substitute that keeps logaddexp gradients finite
+
+
+def _logaddexp(a, b):
+    m = jnp.maximum(a, b)
+    m_safe = jnp.where(m > NEG / 2, m, 0.0)
+    return jnp.where(
+        m > NEG / 2,
+        m_safe + jnp.log(jnp.exp(a - m_safe) + jnp.exp(b - m_safe)),
+        NEG)
+
+
+def _ctc_single(log_probs, labels, T_len, L_len, blank):
+    """One sequence: log_probs (T, C), labels (L,) already 0-indexed
+    w.r.t. the data channels, lengths as scalars."""
+    T, C = log_probs.shape
+    L = labels.shape[0]
+    S = 2 * L + 1
+
+    s_idx = jnp.arange(S)
+    z = jnp.where(s_idx % 2 == 0, blank,
+                  labels[jnp.clip((s_idx - 1) // 2, 0, L - 1)])
+    # s is inside the extended sequence for this label length
+    s_valid = s_idx < 2 * L_len + 1
+    # skip-transition allowed: odd position, differs from label 2 back
+    z_m2 = jnp.where(s_idx >= 2, z[jnp.clip(s_idx - 2, 0, S - 1)], -1)
+    allow_skip = (z != blank) & (z != z_m2)
+
+    lp_z = log_probs[:, jnp.clip(z, 0, C - 1)]      # (T, S)
+
+    alpha0 = jnp.full((S,), NEG)
+    alpha0 = alpha0.at[0].set(lp_z[0, 0])
+    alpha0 = alpha0.at[1].set(jnp.where(L_len > 0, lp_z[0, 1], NEG))
+
+    def step(alpha, xs):
+        lp_t, t = xs
+        prev1 = jnp.concatenate([jnp.full((1,), NEG), alpha[:-1]])
+        prev2 = jnp.concatenate([jnp.full((2,), NEG), alpha[:-2]])
+        acc = _logaddexp(alpha, prev1)
+        acc = jnp.where(allow_skip, _logaddexp(acc, prev2), acc)
+        new = jnp.where(s_valid, acc + lp_t, NEG)
+        # freeze past the true sequence length
+        new = jnp.where(t < T_len, new, alpha)
+        return new, None
+
+    alpha, _ = lax.scan(step, alpha0,
+                        (lp_z[1:], jnp.arange(1, T)))
+    end = 2 * L_len                                  # index of last blank
+    a_last = alpha[jnp.clip(end, 0, S - 1)]
+    a_prev = jnp.where(L_len > 0,
+                       alpha[jnp.clip(end - 1, 0, S - 1)], NEG)
+    return -_logaddexp(a_last, a_prev)
+
+
+@defop("ctc_loss", aliases=("_contrib_CTCLoss", "CTCLoss",
+                            "_contrib_ctc_loss"), variadic=True)
+def ctc_loss(*inputs, use_data_lengths=False, use_label_lengths=False,
+             blank_label="first"):
+    """CTC loss (ref: src/operator/contrib/ctc_loss.cc).
+    inputs: data (T, B, C), label (B, L)
+    [, data_lengths (B,)][, label_lengths (B,)] -> costs (B,)."""
+    data, label = inputs[0], inputs[1]
+    k = 2
+    data_lengths = label_lengths = None
+    if use_data_lengths:
+        data_lengths = inputs[k]
+        k += 1
+    if use_label_lengths:
+        label_lengths = inputs[k]
+
+    T, B, C = data.shape
+    lab = label.astype(jnp.int32)
+    first = (blank_label == "first")
+    blank = 0 if first else C - 1
+    pad = 0 if first else -1
+
+    if label_lengths is None:
+        lab_len = (lab != pad).astype(jnp.int32).sum(axis=1)
+    else:
+        lab_len = label_lengths.astype(jnp.int32)
+    if data_lengths is None:
+        dat_len = jnp.full((B,), T, jnp.int32)
+    else:
+        dat_len = data_lengths.astype(jnp.int32)
+
+    # channel indices of the labels: with blank 'first' the data
+    # channels for real labels are already 1..C-1 as passed
+    log_probs = jax.nn.log_softmax(data.astype(jnp.float32), axis=2)
+
+    costs = jax.vmap(
+        lambda lp, lb, tl, ll: _ctc_single(lp, lb, tl, ll, blank),
+        in_axes=(1, 0, 0, 0))(log_probs, lab, dat_len, lab_len)
+    return costs.astype(data.dtype)

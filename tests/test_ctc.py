@@ -1,0 +1,124 @@
+"""ctc_loss vs brute-force path-enumeration oracle + grad checks
+(ref semantics: src/operator/contrib/ctc_loss.cc / warp-ctc)."""
+import itertools
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, autograd
+
+
+def _collapse(path, blank):
+    out = []
+    prev = None
+    for p in path:
+        if p != prev and p != blank:
+            out.append(p)
+        prev = p
+    return tuple(out)
+
+
+def np_ctc_brute(acts, label, blank):
+    """Exact -log p(label) by enumerating all alphabet^T paths."""
+    T, C = acts.shape
+    e = np.exp(acts - acts.max(axis=1, keepdims=True))
+    probs = e / e.sum(axis=1, keepdims=True)
+    total = 0.0
+    for path in itertools.product(range(C), repeat=T):
+        if _collapse(path, blank) == tuple(label):
+            p = 1.0
+            for t, c in enumerate(path):
+                p *= probs[t, c]
+            total += p
+    return -np.log(total)
+
+
+@pytest.mark.parametrize("blank_label", ["first", "last"])
+def test_ctc_loss_brute_force(blank_label):
+    rs = np.random.RandomState(0)
+    T, B, C = 4, 3, 3
+    acts = rs.randn(T, B, C).astype(np.float32)
+    if blank_label == "first":
+        labels = np.array([[1, 2], [2, 0], [1, 0]], np.float32)
+        seqs = [(1, 2), (2,), (1,)]
+        blank = 0
+    else:
+        labels = np.array([[0, 1], [1, -1], [0, -1]], np.float32)
+        seqs = [(0, 1), (1,), (0,)]
+        blank = C - 1
+    costs = nd._internal._contrib_CTCLoss(
+        nd.array(acts), nd.array(labels),
+        blank_label=blank_label).asnumpy()
+    for b in range(B):
+        want = np_ctc_brute(acts[:, b], seqs[b], blank)
+        np.testing.assert_allclose(costs[b], want, rtol=1e-4)
+
+
+def test_ctc_loss_lengths():
+    rs = np.random.RandomState(1)
+    T, B, C = 6, 2, 4
+    acts = rs.randn(T, B, C).astype(np.float32)
+    labels = np.array([[1, 2, 3], [3, 1, 1]], np.float32)
+    dl = np.array([4, 6], np.float32)
+    ll = np.array([2, 3], np.float32)
+    costs = nd._internal._contrib_CTCLoss(
+        nd.array(acts), nd.array(labels), nd.array(dl), nd.array(ll),
+        use_data_lengths=True, use_label_lengths=True).asnumpy()
+    want0 = np_ctc_brute(acts[:4, 0], (1, 2), 0)
+    want1 = np_ctc_brute(acts[:, 1], (3, 1, 1), 0)
+    np.testing.assert_allclose(costs, [want0, want1], rtol=1e-4)
+
+
+def test_ctc_loss_grad_finite_diff():
+    rs = np.random.RandomState(2)
+    T, B, C = 3, 1, 3
+    acts = rs.randn(T, B, C).astype(np.float32)
+    labels = np.array([[1, 2]], np.float32)
+
+    x = nd.array(acts)
+    x.attach_grad()
+    with autograd.record():
+        loss = nd._internal._contrib_CTCLoss(x, nd.array(labels))
+    loss.backward()
+    g = x.grad.asnumpy()
+
+    eps = 1e-3
+    for t in range(T):
+        for c in range(C):
+            ap = acts.copy(); ap[t, 0, c] += eps
+            am = acts.copy(); am[t, 0, c] -= eps
+            fp = np_ctc_brute(ap[:, 0], (1, 2), 0)
+            fm = np_ctc_brute(am[:, 0], (1, 2), 0)
+            np.testing.assert_allclose(g[t, 0, c], (fp - fm) / (2 * eps),
+                                       atol=2e-3)
+
+
+def test_gluon_ctc_loss():
+    """The shipped gluon CTCLoss must execute (round-1 bug) and use the
+    gluon 'blank last' convention: classes 0..C-2, padding -1 (ref:
+    gluon/loss.py:439-446)."""
+    rs = np.random.RandomState(3)
+    loss_fn = mx.gluon.loss.CTCLoss(layout="NTC")
+    T, C = 5, 4
+    pred_np = rs.randn(2, T, C).astype(np.float32)  # (N, T, C)
+    label = nd.array(np.array([[1, 2], [0, -1]], np.float32))
+    out = loss_fn(nd.array(pred_np), label).asnumpy()
+    assert out.shape == (2,)
+    # class 0 is a REAL token and -1 is padding; blank is channel C-1
+    want0 = np_ctc_brute(pred_np[0], (1, 2), C - 1)
+    want1 = np_ctc_brute(pred_np[1], (0,), C - 1)
+    np.testing.assert_allclose(out, [want0, want1], rtol=1e-4)
+
+
+def test_gluon_ctc_loss_grad():
+    rs = np.random.RandomState(4)
+    loss_fn = mx.gluon.loss.CTCLoss()
+    pred = nd.array(rs.randn(2, 5, 4).astype(np.float32))
+    label = nd.array(np.array([[1, 2], [0, -1]], np.float32))
+    pred.attach_grad()
+    with autograd.record():
+        loss = loss_fn(pred, label).sum()
+    loss.backward()
+    g = pred.grad.asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
