@@ -100,10 +100,8 @@ class ShardedTrainStep:
         self.param_shardings = self.rules.shardings(self.mesh, pvals)
         self.state_shardings = {
             n: NamedSharding(self.mesh, P()) for n in svals}
-        self.params = {n: jax.device_put(v, self.param_shardings[n])
-                       for n, v in pvals.items()}
-        self.states = {n: jax.device_put(v, self.state_shardings[n])
-                       for n, v in svals.items()}
+        self.params = _owned_put_tree(pvals, self.param_shardings)
+        self.states = _owned_put_tree(svals, self.state_shardings)
         self.opt_state = self.opt.init(self.params)
         self._step = None
         self._eval = None
@@ -180,10 +178,45 @@ class ShardedTrainStep:
         return self._eval(self.params, self.states, x, rng)
 
     def write_back(self):
-        """Copy mesh values back into the Gluon Parameter objects."""
-        self.pure.write_back(self.params, self.states)
+        """Copy mesh values back into the Gluon Parameter objects.
+
+        Hands the Parameters *owned copies*, never the step's own
+        buffers — those are donated by the next step() and would turn
+        the live Parameters into deleted arrays.
+        """
+        self.pure.write_back(_copy_tree(self.params),
+                             _copy_tree(self.states))
 
 
 def _raw(a):
     from ..ndarray.ndarray import NDArray
     return a._data if isinstance(a, NDArray) else jnp.asarray(a)
+
+
+def _owned_put_tree(vals, shardings):
+    """Lay ``vals`` out per ``shardings`` in buffers this step *owns*.
+
+    ``jax.device_put`` returns a view sharing the input's buffer when
+    the value already lives on the target devices (and aliasing is
+    undetectable on backends without unsafe_buffer_pointer, e.g.
+    axon) — donating such a view in the compiled step would delete
+    the caller's array (the live gluon Parameter, or a sibling
+    ShardedTrainStep built on the same block).  Force a real copy via
+    one compiled add over the whole tree (single compile, not one per
+    parameter — compiles are expensive over remote backends).
+    """
+    placed = {n: jax.device_put(v, shardings[n])
+              for n, v in vals.items()}
+    if not placed:
+        return placed
+    return jax.jit(_copy_impl, out_shardings=shardings)(placed)
+
+
+def _copy_impl(t):
+    return {n: a + jnp.zeros((), a.dtype) for n, a in t.items()}
+
+
+# module-level fn so jax's jit cache is keyed on shapes/shardings and
+# repeat constructions / write_backs hit the cache instead of
+# re-tracing a fresh lambda every time
+_copy_tree = jax.jit(_copy_impl)

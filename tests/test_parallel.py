@@ -259,3 +259,54 @@ def test_ring_attention_grad():
     np.testing.assert_allclose(np.asarray(jax.grad(f)(q)),
                                np.asarray(jax.grad(f_ref)(q)),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_two_steps_same_block_donation_safe():
+    """Donation must not delete the live Parameters or a sibling
+    step's buffers: device_put aliases when a value already lives on
+    the target device (regression: axon backend, round 3)."""
+    rs = np.random.RandomState(9)
+    mx.random.seed(0)
+    net = mx.gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(mx.gluon.nn.Dense(8, in_units=6),
+                mx.gluon.nn.BatchNorm(), mx.gluon.nn.Dense(3))
+    net.initialize(mx.initializer.Xavier())
+    pure = parallel.functionalize(net, jnp.zeros((4, 6), jnp.float32))
+    x = jnp.asarray(rs.rand(8, 6), jnp.float32)
+    y = jnp.asarray(rs.randint(0, 3, (8,)), jnp.int32)
+
+    def make():
+        return parallel.ShardedTrainStep(
+            pure, optimizer="sgd",
+            optimizer_params=dict(learning_rate=0.1),
+            mesh=parallel.make_mesh())
+
+    s1 = make()
+    float(s1(x, y, rng=jax.random.PRNGKey(0)))
+    s2 = make()  # reads the live Parameters again
+    float(s2(x, y, rng=jax.random.PRNGKey(0)))
+    float(s1(x, y, rng=jax.random.PRNGKey(0)))  # s1 still usable
+    for p in net.collect_params().values():
+        assert not p.data()._data.is_deleted()
+
+
+def test_write_back_then_step_keeps_parameters_alive():
+    """write_back must hand Parameters owned copies, not the step's
+    donated buffers (regression: round 3, reverse aliasing path)."""
+    rs = np.random.RandomState(10)
+    mx.random.seed(0)
+    net = mx.gluon.nn.Dense(3, in_units=6)
+    net.initialize(mx.initializer.Xavier())
+    step = parallel.ShardedTrainStep(
+        net, optimizer="sgd", optimizer_params=dict(learning_rate=0.1),
+        mesh=parallel.make_mesh(),
+        example_args=[jnp.zeros((2, 6), jnp.float32)])
+    x = jnp.asarray(rs.rand(8, 6), jnp.float32)
+    y = jnp.asarray(rs.randint(0, 3, (8,)), jnp.int32)
+    float(step(x, y))
+    step.write_back()
+    float(step(x, y))  # donates step buffers; Parameters must survive
+    for p in net.collect_params().values():
+        assert not p.data()._data.is_deleted()
+        np.asarray(p.data()._data)  # still readable
