@@ -1,13 +1,26 @@
 """Gluon Trainer (ref: python/mxnet/gluon/trainer.py — _init_kvstore:102,
 step pushes grads / pulls weights per parameter).
 
-TPU-native: with kvstore='tpu' gradients are already mesh-reduced
-inside the compiled step (psum via sharding), so step() is just the
-optimizer application; the kvstore path is kept for API parity and
-multi-process setups.
+TPU-native: the default hot path is a *fused in-jit update* — one
+compiled call applying the optimizer to the whole parameter pytree
+(with the reference's per-parameter lr_mult/wd_mult semantics as
+multiplier trees), instead of the reference's per-parameter Python
+loop of push/pull/updater calls.  Learning rate and grad rescale are
+traced scalars, so schedulers run without recompiles.
+
+With kvstore='tpu' gradients are already mesh-reduced inside the
+compiled step that produced them (psum via sharding), so step() is
+just the fused optimizer application; 'device'/'local' behave the
+same on one process.  Optimizers without a functional counterpart
+(see parallel.optim.from_imperative) fall back to the eager per-param
+updater loop transparently.
 """
+import jax
+import jax.numpy as jnp
+
 from .. import optimizer as opt_mod
 from ..model import _create_kvstore
+from ..parallel import optim as foptim
 
 __all__ = ["Trainer"]
 
@@ -33,6 +46,21 @@ class Trainer:
         self._kvstore_spec = kvstore
         self._kvstore = None
         self._kv_initialized = False
+        self._fopt = None        # functional optimizer (fused path)
+        self._fstate = None
+        self._fused_update = None
+        if kvstore == "tpu":
+            # replicate now so the *first* forward on a 'dp'-sharded
+            # batch already computes distributed (step() comes later)
+            self._replicate_params()
+
+    def _replicate_params(self):
+        from ..parallel import current_mesh, make_mesh, replicated
+        mesh = current_mesh() or make_mesh()
+        rep = replicated(mesh)
+        for p in self._params:
+            if p._data is not None:
+                p._data._data = jax.device_put(p._data._data, rep)
 
     @property
     def learning_rate(self):
@@ -43,31 +71,114 @@ class Trainer:
 
     def _init_kvstore(self):
         """(ref: trainer.py:102)"""
-        arg_params = {p.name: p.data() for p in self._params}
-        kv, update_on_kvstore = _create_kvstore(
-            self._kvstore_spec, 1, arg_params)
-        self._kvstore = kv
-        self._update_on_kvstore = update_on_kvstore and kv is not None
-        if kv is not None:
-            for i, p in enumerate(self._params):
-                kv.init(i, p.data())
-            if self._update_on_kvstore:
-                kv.set_optimizer(self._optimizer)
+        if self._kvstore_spec == "tpu":
+            # mesh path: parameters replicated over the ambient mesh
+            # (done in __init__, repeated here for deferred-init
+            # parameters); grads were already mesh-reduced inside the
+            # computation that produced them; no store object needed
+            self._replicate_params()
+            self._kvstore = None
+            self._update_on_kvstore = False
+        else:
+            arg_params = {p.name: p.data() for p in self._params}
+            kv, update_on_kvstore = _create_kvstore(
+                self._kvstore_spec, 1, arg_params)
+            self._kvstore = kv
+            self._update_on_kvstore = update_on_kvstore and \
+                kv is not None
+            if kv is not None:
+                for i, p in enumerate(self._params):
+                    kv.init(i, p.data())
+                if self._update_on_kvstore:
+                    kv.set_optimizer(self._optimizer)
         self._kv_initialized = True
+
+    # ---------------------------------------------------------- fused
+    def _init_fused(self):
+        """Resolve the functional optimizer for the one-jit-call
+        whole-tree update (None counterpart -> eager loop)."""
+        opt = self._optimizer
+        self._fopt = foptim.from_imperative(opt)
+        if self._fopt is None:
+            self._fused_update = False  # sentinel: use eager loop
+            return
+        self._fused_update = {}  # per stale-grad-mask compiled variants
+        self._fstate = self._fopt.init(
+            {p.name: p.data()._data for p in self._params})
+
+    def _fused_variant(self, missing_names):
+        """Compiled update skipping ``missing_names`` (stale grads):
+        the reference leaves both weight and optimizer state of a
+        grad-less parameter untouched, so the fused step restores
+        those leaves after the whole-tree update."""
+        fn = self._fused_update.get(missing_names)
+        if fn is not None:
+            return fn
+        opt, fopt = self._optimizer, self._fopt
+        lr_mults = {p.name: opt.lr_mult.get(p.name, 1.0)
+                    for p in self._params}
+        wd_mults = foptim.default_wd_mults(
+            [p.name for p in self._params], opt.wd_mult)
+
+        def upd(params, grads, state, scale, lr):
+            new_p, new_s = fopt.update(params, grads, state,
+                                       scale=scale, lr=lr,
+                                       lr_mults=lr_mults,
+                                       wd_mults=wd_mults)
+            if missing_names:
+                new_p = dict(new_p)
+                for n in missing_names:
+                    new_p[n] = params[n]
+                new_s = {k: ({**v, **{n: state[k][n]
+                                      for n in missing_names if n in v}}
+                             if isinstance(v, dict) else v)
+                         for k, v in new_s.items()}
+            return new_p, new_s
+
+        fn = jax.jit(upd, donate_argnums=(0, 2))
+        self._fused_update[missing_names] = fn
+        return fn
+
+    def _fused_active(self):
+        if self._fused_update in (None, False):
+            return False
+        kv = self._kvstore
+        return not (kv is not None
+                    and getattr(kv, "num_workers", 1) > 1)
 
     def step(self, batch_size, ignore_stale_grad=False):
         """Apply one optimizer step scaled by 1/batch_size
         (ref: trainer.py step)."""
         if not self._kv_initialized:
             self._init_kvstore()
+        if self._fused_update is None:
+            self._init_fused()
         self._optimizer.rescale_grad = self._scale / batch_size
+
+        missing = [p for p in self._params if p._grad is None]
+        if missing and not ignore_stale_grad:
+            raise UserWarning(
+                f"Gradient of Parameter `{missing[0].name}` not set; "
+                "call backward first, or set ignore_stale_grad=True")
+
+        if self._fused_active():
+            params = {p.name: p.data()._data for p in self._params}
+            grads = {p.name: (p._grad._data if p._grad is not None
+                              else jnp.zeros_like(p.data()._data))
+                     for p in self._params}
+            fn = self._fused_variant(
+                tuple(sorted(p.name for p in missing)))
+            new_p, self._fstate = fn(
+                params, grads, self._fstate,
+                jnp.asarray(self._optimizer.rescale_grad, jnp.float32),
+                jnp.asarray(foptim.scheduled_lr(self._optimizer),
+                            jnp.float32))
+            for p in self._params:
+                p._data._data = new_p[p.name]
+            return
+
         for i, p in enumerate(self._params):
             if p._grad is None:
-                if not ignore_stale_grad:
-                    raise UserWarning(
-                        f"Gradient of Parameter `{p.name}` not set; "
-                        "call backward first, or set "
-                        "ignore_stale_grad=True")
                 continue
             if self._kvstore is not None and self._update_on_kvstore:
                 self._kvstore.push(i, p.grad(), priority=-i)
@@ -89,9 +200,34 @@ class Trainer:
         self.step(batch_size, ignore_stale_grad)
 
     def save_states(self, fname):
+        import pickle
+        if self._fused_active() and self._fstate is not None:
+            import numpy as np
+            tree = jax.tree_util.tree_map(np.asarray, self._fstate)
+            with open(fname, "wb") as f:
+                pickle.dump({"fused": tree}, f)
+            return
         with open(fname, "wb") as f:
             f.write(self._updater.get_states())
 
     def load_states(self, fname):
+        import pickle
         with open(fname, "rb") as f:
-            self._updater.set_states(f.read())
+            raw = f.read()
+        try:
+            obj = pickle.loads(raw)
+        except Exception:
+            obj = None
+        if isinstance(obj, dict) and "fused" in obj:
+            if self._fused_update is None:
+                self._init_fused()
+            if not self._fused_active():
+                raise ValueError(
+                    "states file was saved by the fused update path "
+                    "but this Trainer's optimizer has no functional "
+                    "counterpart (or runs on a multi-worker kvstore); "
+                    "cannot restore")
+            self._fstate = jax.tree_util.tree_map(jnp.asarray,
+                                                  obj["fused"])
+            return
+        self._updater.set_states(raw)

@@ -44,6 +44,10 @@ class Module(BaseModule):
         self._update_on_kvstore = False
         self._data_shapes = None
         self._label_shapes = None
+        self._mesh_step = None   # kvstore='tpu' fused path
+        self._mesh_dirty = False    # step params newer than exec dicts
+        self._mesh_pending = False  # fused step ran; update() owes a no-op
+        self._mesh_stale = False    # exec dicts newer than step params
 
     # ------------------------------------------------------------ bind
     @property
@@ -140,6 +144,7 @@ class Module(BaseModule):
         self.params_initialized = True
 
     def get_params(self):
+        self._sync_mesh_params()
         arg = {n: self._exec.arg_dict[n].copy()
                for n in self._param_names}
         aux = {n: self._exec.aux_dict[n].copy()
@@ -155,8 +160,9 @@ class Module(BaseModule):
             return
         arg_params = {n: self._exec.arg_dict[n]
                       for n in self._param_names}
-        kv, update_on_kvstore = _create_kvstore(
-            kvstore, 1, arg_params)
+        use_mesh_step = (isinstance(kvstore, str) and kvstore == "tpu")
+        kv, update_on_kvstore = (None, False) if use_mesh_step else \
+            _create_kvstore(kvstore, 1, arg_params)
         if isinstance(optimizer, str):
             params = dict(optimizer_params or ())
             # reference default: scale summed grads by 1/batch_size
@@ -175,12 +181,14 @@ class Module(BaseModule):
         self._kvstore = kv
         self._update_on_kvstore = update_on_kvstore and kv is not None
         self._updater = None
+        if use_mesh_step:
+            self._init_mesh_step()
         if kv is not None:
             for i, name in enumerate(self._param_names):
                 kv.init(i, self._exec.arg_dict[name])
             if self._update_on_kvstore:
                 kv.set_optimizer(self._optimizer)
-        if not self._update_on_kvstore:
+        if not self._update_on_kvstore and not use_mesh_step:
             self._updater = opt_mod.get_updater(optimizer)
         self.optimizer_initialized = True
         states = getattr(self, "_preload_opt_states", None)
@@ -188,12 +196,83 @@ class Module(BaseModule):
             self.load_optimizer_states(states)
             self._preload_opt_states = None
 
+    # ------------------------------------------------------------ mesh
+    def _init_mesh_step(self):
+        """kvstore='tpu': build the fused mesh training step.
+
+        Replaces DataParallelExecutorGroup batch slicing + kvstore
+        push/pull (ref: python/mxnet/module/executor_group.py:99) with
+        one jit step over the ambient mesh: batch sharded on 'dp',
+        grads psum'd by XLA, functional optimizer applied in-jit.
+        """
+        from ..parallel import current_mesh, make_mesh
+        from ..parallel.symbol_step import SymbolTrainStep
+        opt = self._optimizer
+        fopt = _to_functional_optimizer(opt)
+        if fopt is None:
+            raise ValueError(
+                f"kvstore='tpu' supports sgd/nag/adam-family "
+                f"optimizers in the fused step; got "
+                f"{type(opt).__name__}. Use kvstore='device' for the "
+                "eager update path.")
+        trainable = [n for n in self._param_names
+                     if n in self._exec.grad_dict]
+        pvals = {n: self._exec.arg_dict[n]._data for n in trainable}
+        # fixed params + aux states ride in the aux (constant) slot
+        aux_vals = {n: self._exec.aux_dict[n]._data
+                    for n in self._aux_names}
+        aux_vals.update({n: self._exec.arg_dict[n]._data
+                         for n in self._param_names
+                         if n not in self._exec.grad_dict})
+        input_names = [d.name for d in self._data_shapes]
+        input_names += [d.name for d in (self._label_shapes or [])
+                        if d.name in self._exec.arg_dict]
+        from ..parallel.optim import default_wd_mults
+        wd_mults = default_wd_mults(trainable, opt.wd_mult)
+        lr_mults = {n: opt.lr_mult.get(n, 1.0) for n in trainable}
+        mesh = current_mesh() or make_mesh()
+        self._mesh_step = SymbolTrainStep(
+            self._symbol, pvals, aux_vals, input_names,
+            optimizer=fopt, mesh=mesh,
+            rescale_grad=getattr(opt, "rescale_grad", 1.0),
+            lr_mults=lr_mults, wd_mults=wd_mults)
+
+    def _sync_mesh_params(self):
+        """Pull owned copies from the mesh step back into the
+        executor dicts (lazy: only when values are actually read)."""
+        if self._mesh_step is None or not self._mesh_dirty:
+            return
+        params, aux = self._mesh_step.owned_values()
+        for n, v in params.items():
+            self._exec.arg_dict[n]._data = v
+        for n, v in aux.items():
+            if n in self._exec.aux_dict:
+                self._exec.aux_dict[n]._data = v
+            else:  # fixed params rode in the aux slot
+                self._exec.arg_dict[n]._data = v
+        self._mesh_dirty = False
+
     # ------------------------------------------------------------ step
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
         if is_train is None:
             is_train = self.for_training
         inputs = self._batch_inputs(data_batch)
+        if not is_train and self._mesh_step is not None \
+                and not self._mesh_stale:
+            vals = {k: (v._data if isinstance(v, NDArray) else v)
+                    for k, v in inputs.items()}
+            need = self._mesh_step.input_names
+            dp = self._mesh_step.mesh.shape["dp"]
+            batches = [vals[n].shape[0] for n in need if n in vals]
+            if set(need) <= set(vals) and \
+                    all(b % dp == 0 for b in batches):
+                # compiled sharded eval over the mesh (score/predict)
+                outs = self._mesh_step.evaluate(
+                    {n: vals[n] for n in need})
+                self._exec._outputs = [NDArray(o) for o in outs]
+                return
+        self._sync_mesh_params()
         self._exec.forward(is_train=is_train, **inputs)
 
     def _batch_inputs(self, data_batch):
@@ -213,12 +292,47 @@ class Module(BaseModule):
 
     def forward_backward(self, data_batch):
         """Fused single-XLA-call training step (outputs + grads)."""
+        if self._mesh_step is not None:
+            from ..parallel.optim import scheduled_lr
+            if self._mesh_stale:
+                # an eager update touched the exec dicts; refresh the
+                # step's device values before continuing fused
+                self._push_mesh_params()
+            inputs = {k: v._data if isinstance(v, NDArray) else v
+                      for k, v in self._batch_inputs(data_batch).items()}
+            outs = self._mesh_step(inputs,
+                                   lr=scheduled_lr(self._optimizer))
+            self._exec._outputs = [NDArray(o) for o in outs]
+            self._mesh_dirty = True
+            self._mesh_pending = True
+            return
         self._exec.forward_backward(**self._batch_inputs(data_batch))
+
+    def _push_mesh_params(self):
+        trainable = {n: self._exec.arg_dict[n]._data
+                     for n in self._mesh_step.params}
+        aux = {n: (self._exec.aux_dict[n]._data
+                   if n in self._exec.aux_dict
+                   else self._exec.arg_dict[n]._data)
+               for n in self._mesh_step.aux}
+        self._mesh_step.set_values(trainable, aux)
+        self._mesh_stale = False
 
     def update(self):
         """(ref: module.py update:619 / model.py
         _update_params_on_kvstore:105)"""
         assert self.optimizer_initialized
+        if self._mesh_step is not None:
+            if self._mesh_pending:
+                # the optimizer already ran inside the fused mesh step
+                self._mesh_pending = False
+                return
+            # manual forward/backward loop with kvstore='tpu': apply
+            # the eager updater so update() is never a silent no-op
+            if self._updater is None:
+                self._updater = opt_mod.get_updater(self._optimizer)
+            self._sync_mesh_params()
+            self._mesh_stale = True
         for i, name in enumerate(self._param_names):
             grad = self._exec.grad_dict.get(name)
             if grad is None:  # fixed / grad_req=null parameters
@@ -256,7 +370,15 @@ class Module(BaseModule):
 
     def save_optimizer_states(self, fname):
         assert self.optimizer_initialized
-        if self._update_on_kvstore:
+        if self._mesh_step is not None:
+            import pickle
+            import numpy as _np
+            import jax as _jax
+            tree = _jax.tree_util.tree_map(_np.asarray,
+                                           self._mesh_step.opt_state)
+            with open(fname, "wb") as f:
+                pickle.dump(tree, f)
+        elif self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
         else:
             with open(fname, "wb") as f:
@@ -264,7 +386,15 @@ class Module(BaseModule):
 
     def load_optimizer_states(self, fname):
         assert self.optimizer_initialized
-        if self._update_on_kvstore:
+        if self._mesh_step is not None:
+            import pickle
+            import jax as _jax
+            import jax.numpy as _jnp
+            with open(fname, "rb") as f:
+                tree = pickle.load(f)
+            self._mesh_step.opt_state = _jax.tree_util.tree_map(
+                _jnp.asarray, tree)
+        elif self._update_on_kvstore:
             self._kvstore.load_optimizer_states(fname)
         else:
             with open(fname, "rb") as f:
@@ -287,3 +417,8 @@ def _to_desc(d):
     from ..io.io import DataDesc
     name, shape = d
     return DataDesc(name, shape)
+
+
+def _to_functional_optimizer(opt):
+    from ..parallel.optim import from_imperative
+    return from_imperative(opt)

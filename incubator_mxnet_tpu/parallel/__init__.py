@@ -19,6 +19,7 @@ from .functional import functionalize, PureBlock
 from . import optim
 from .sharding import ShardingRules, tp_rules_for_dense_stacks, constrain
 from .data_parallel import ShardedTrainStep
+from .symbol_step import SymbolTrainStep
 from .pipeline import pipeline_apply, stack_stage_params
 from .ring_attention import ring_attention, ring_attention_local
 
@@ -26,5 +27,6 @@ __all__ = ["AXES", "make_mesh", "current_mesh", "use_mesh",
            "named_sharding", "replicated", "shard_batch", "P",
            "functionalize", "PureBlock", "optim", "ShardingRules",
            "tp_rules_for_dense_stacks", "constrain",
-           "ShardedTrainStep", "pipeline_apply", "stack_stage_params",
+           "ShardedTrainStep", "SymbolTrainStep",
+           "pipeline_apply", "stack_stage_params",
            "ring_attention", "ring_attention_local"]

@@ -24,6 +24,37 @@ def _tree_map(f, *trees, **kw):
     return jax.tree_util.tree_map(f, *trees, **kw)
 
 
+def _ones_tree(params):
+    return _tree_map(lambda _: 1.0, params)
+
+
+def _cast(v, dtype):
+    """Cast a (possibly traced, strong-f32) scalar to the parameter's
+    dtype so fp16/bf16 parameters are not silently upcast by the
+    update arithmetic."""
+    return jnp.asarray(v).astype(dtype)
+
+
+def default_wd_mults(names, overrides=None):
+    """The reference's wd_mult default rule (ref:
+    python/mxnet/optimizer.py set_wd_mult/_get_wd): parameters whose
+    name does not end in ``_weight``/``_gamma`` default to 0."""
+    overrides = overrides or {}
+    return {n: overrides.get(
+        n, 1.0 if (n.endswith("_weight") or n.endswith("_gamma"))
+        else 0.0) for n in names}
+
+
+def scheduled_lr(opt):
+    """Advance ``opt.num_update`` and return the lr for this update —
+    the same increment-then-read order as the eager Updater path
+    (ref: python/mxnet/optimizer.py _update_count then _get_lr)."""
+    opt.num_update += 1
+    if opt.lr_scheduler is not None:
+        return opt.lr_scheduler(opt.num_update)
+    return opt.lr
+
+
 class FunctionalOptimizer:
     """A pure optimizer: init(params)->state; update(...)->new pair."""
 
@@ -35,8 +66,17 @@ class FunctionalOptimizer:
     def init(self, params):
         return self._init(params)
 
-    def update(self, params, grads, state, scale=1.0):
-        return self._update(params, grads, state, scale)
+    def update(self, params, grads, state, scale=1.0, lr=None,
+               lr_mults=None, wd_mults=None):
+        """``lr`` (scalar, may be traced) overrides the constructed
+        learning rate — pass it as a jnp scalar argument so schedulers
+        don't force recompiles.  ``lr_mults`` / ``wd_mults`` are
+        per-leaf multiplier pytrees implementing the reference's
+        lr_mult/wd_mult semantics (ref: python/mxnet/optimizer.py
+        _get_lr/_get_wd — e.g. wd_mult defaults to 0 for non-weight,
+        non-gamma parameters)."""
+        return self._update(params, grads, state, scale, lr,
+                            lr_mults, wd_mults)
 
 
 def sgd(learning_rate=0.01, momentum=0.0, wd=0.0, clip_gradient=None,
@@ -54,25 +94,33 @@ def sgd(learning_rate=0.01, momentum=0.0, wd=0.0, clip_gradient=None,
             return {}
         return {"mom": _tree_map(jnp.zeros_like, params)}
 
-    def update_fn(params, grads, state, scale):
-        def one(w, g, m=None):
-            g = g * scale
+    def update_fn(params, grads, state, scale, lr_dyn=None,
+                  lr_mults=None, wd_mults=None):
+        base_lr = lr if lr_dyn is None else lr_dyn
+        lr_mults = lr_mults or _ones_tree(params)
+        wd_mults = wd_mults or _ones_tree(params)
+
+        def one(w, g, m, lm, wm):
+            g = g * _cast(scale, g.dtype)
             if clip_gradient is not None:
                 g = jnp.clip(g, -clip_gradient, clip_gradient)
-            g = g + wdec * w
+            g = g + (wdec * wm) * w
+            lr_e = _cast(base_lr, w.dtype) * lm
             if m is None:
-                return w - lr * g, None
+                return w - lr_e * g, None
             if nesterov:
                 m_new = mom * m + g
-                return w - lr * (g + mom * m_new), m_new
-            m_new = mom * m - lr * g
+                return w - lr_e * (g + mom * m_new), m_new
+            m_new = mom * m - lr_e * g
             return w + m_new, m_new
 
         if mom == 0.0:
-            new_p = _tree_map(lambda w, g: one(w, g)[0], params, grads)
+            new_p = _tree_map(
+                lambda w, g, lm, wm: one(w, g, None, lm, wm)[0],
+                params, grads, lr_mults, wd_mults)
             return new_p, state
-        pairs = _tree_map(lambda w, g, m: one(w, g, m),
-                          params, grads, state["mom"])
+        pairs = _tree_map(one, params, grads, state["mom"],
+                          lr_mults, wd_mults)
         new_p = _tree_map(lambda pr: pr[0], pairs,
                           is_leaf=lambda x: isinstance(x, tuple))
         new_m = _tree_map(lambda pr: pr[1], pairs,
@@ -93,23 +141,29 @@ def adam(learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                 "var": _tree_map(jnp.zeros_like, params),
                 "t": jnp.zeros((), jnp.int32)}
 
-    def update_fn(params, grads, state, scale):
+    def update_fn(params, grads, state, scale, lr_dyn=None,
+                  lr_mults=None, wd_mults=None):
         t = state["t"] + 1
         coef1 = 1.0 - beta1 ** t.astype(jnp.float32)
         coef2 = 1.0 - beta2 ** t.astype(jnp.float32)
-        lr_t = lr * jnp.sqrt(coef2) / coef1
+        base_lr = lr if lr_dyn is None else lr_dyn
+        lr_t = base_lr * jnp.sqrt(coef2) / coef1
+        lr_mults = lr_mults or _ones_tree(params)
+        wd_mults = wd_mults or _ones_tree(params)
 
-        def one(w, g, m, v):
-            g = g * scale
+        def one(w, g, m, v, lm, wm):
+            g = g * _cast(scale, g.dtype)
             if clip_gradient is not None:
                 g = jnp.clip(g, -clip_gradient, clip_gradient)
-            g = g + wd * w
+            g = g + (wd * wm) * w
             m_new = beta1 * m + (1 - beta1) * g
             v_new = beta2 * v + (1 - beta2) * g * g
-            w_new = w - lr_t * m_new / (jnp.sqrt(v_new) + epsilon)
+            w_new = w - (_cast(lr_t, w.dtype) * lm) * m_new / (
+                jnp.sqrt(v_new) + epsilon)
             return w_new, m_new, v_new
 
-        trip = _tree_map(one, params, grads, state["mean"], state["var"])
+        trip = _tree_map(one, params, grads, state["mean"], state["var"],
+                         lr_mults, wd_mults)
         is_t = lambda x: isinstance(x, tuple)  # noqa: E731
         return (_tree_map(lambda p: p[0], trip, is_leaf=is_t),
                 {"mean": _tree_map(lambda p: p[1], trip, is_leaf=is_t),
@@ -137,3 +191,25 @@ def create(name, **kwargs):
             f"{sorted(_REGISTRY)} (use the imperative optimizer zoo "
             "for the others)")
     return _REGISTRY[key](**kwargs)
+
+
+def from_imperative(opt):
+    """Map an imperative ``optimizer.Optimizer`` onto its functional
+    in-jit equivalent (None if it has no fused counterpart — callers
+    fall back to the eager per-param updater)."""
+    from .. import optimizer as opt_mod
+    common = dict(learning_rate=opt.lr, wd=opt.wd,
+                  clip_gradient=opt.clip_gradient)
+    if getattr(opt, "multi_precision", False):
+        # fp32-master-weight semantics live in the imperative mp_sgd
+        # path (and in ShardedTrainStep's compute_dtype); no silent
+        # downgrade here
+        return None
+    if isinstance(opt, opt_mod.NAG):
+        return create("nag", momentum=opt.momentum, **common)
+    if type(opt) is opt_mod.SGD:
+        return create("sgd", momentum=opt.momentum, **common)
+    if type(opt) is opt_mod.Adam:
+        return create("adam", beta1=opt.beta1, beta2=opt.beta2,
+                      epsilon=opt.epsilon, **common)
+    return None

@@ -1,0 +1,172 @@
+"""SymbolTrainStep: one compiled fwd+bwd+optimizer step for a Symbol
+graph over a device mesh — the `kvstore='tpu'` execution path of the
+Module frontend.
+
+This replaces the reference's DataParallelExecutorGroup, which slices
+each batch across devices and allreduces gradients through KVStore
+(ref: python/mxnet/module/executor_group.py:99,
+python/mxnet/model.py _update_params_on_kvstore:105).  Here the whole
+training iteration — graph forward, implicit-loss backward (the
+Output-op ones-cotangent contract), gradient mean over the 'dp' mesh
+axis (XLA inserts the psum), and the functional optimizer update — is
+a single jit executable whose batch inputs are laid out sharded over
+'dp'.
+
+Learning rate is a *traced scalar argument* so lr schedulers step
+without recompiling; lr_mult/wd_mult become per-leaf multiplier trees
+(ref: python/mxnet/optimizer.py _get_lr/_get_wd).
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..executor import build_graph_fn, _ones_ct
+from .data_parallel import _owned_put_tree, _copy_tree
+from .mesh import make_mesh, replicated, shard_batch
+from . import optim as foptim
+
+__all__ = ["SymbolTrainStep"]
+
+
+class SymbolTrainStep:
+    """Compiled mesh training step over a bound Symbol.
+
+    Parameters
+    ----------
+    symbol : Symbol — the full graph incl. loss-output heads
+    param_vals / aux_vals : dict[str, jax.Array] initial values
+    input_names : ordered data+label variable names fed per batch
+    optimizer : FunctionalOptimizer (or name) applied in-jit
+    rescale_grad : float — reference Module semantics (1/global-batch)
+    lr_mults / wd_mults : per-param multipliers (name -> float)
+    """
+
+    def __init__(self, symbol, param_vals, aux_vals, input_names,
+                 optimizer="sgd", optimizer_params=None, mesh=None,
+                 rescale_grad=1.0, lr_mults=None, wd_mults=None,
+                 batch_axis=0):
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self._run = build_graph_fn(symbol)
+        self._param_names = tuple(sorted(param_vals))
+        self._input_names = tuple(input_names)
+        self._batch_axis = batch_axis
+        if isinstance(optimizer, str):
+            self.opt = foptim.create(optimizer,
+                                     **(optimizer_params or {}))
+        else:
+            self.opt = optimizer
+        self.rescale_grad = float(rescale_grad)
+        self._lr_mults = {n: float((lr_mults or {}).get(n, 1.0))
+                          for n in self._param_names}
+        self._wd_mults = {n: float((wd_mults or {}).get(n, 1.0))
+                          for n in self._param_names}
+
+        rep = {n: replicated(self.mesh) for n in param_vals}
+        self.params = _owned_put_tree(dict(param_vals), rep)
+        arep = {n: replicated(self.mesh) for n in aux_vals}
+        self.aux = _owned_put_tree(dict(aux_vals), arep)
+        self.opt_state = self.opt.init(self.params)
+        self._step = None
+        self._eval = None
+
+    # ------------------------------------------------------------ build
+    def _in_shard(self, ndim):
+        return shard_batch(self.mesh, ndim, self._batch_axis)
+
+    def _build(self, inputs):
+        run, opt = self._run, self.opt
+        pnames = self._param_names
+        scale = self.rescale_grad
+        lr_mults, wd_mults = self._lr_mults, self._wd_mults
+
+        def step(params, aux, opt_state, inputs, rng, lr):
+            def inner(pvals):
+                merged = dict(inputs)
+                merged.update(zip(pnames, pvals))
+                outs, aux_upd = run(merged, aux, rng, True)
+                return outs, aux_upd
+
+            primals = tuple(params[n] for n in pnames)
+            (outs, aux_upd), vjp = jax.vjp(inner, primals)
+            cts = [_ones_ct(o) for o in outs]
+            aux_ct = {k: (np.zeros(v.shape, jax.dtypes.float0)
+                          if not jnp.issubdtype(v.dtype, jnp.floating)
+                          else jnp.zeros(v.shape, v.dtype))
+                      for k, v in aux_upd.items()}
+            (gvals,) = vjp((cts, aux_ct))
+            grads = dict(zip(pnames, gvals))
+            new_params, new_opt = opt.update(
+                params, grads, opt_state, scale=scale, lr=lr,
+                lr_mults=lr_mults, wd_mults=wd_mults)
+            new_aux = dict(aux)
+            new_aux.update(aux_upd)
+            return new_params, new_aux, new_opt, outs
+
+        rep = replicated(self.mesh)
+        p_sh = {n: rep for n in self.params}
+        a_sh = {n: rep for n in self.aux}
+        in_sh = {n: self._in_shard(v.ndim) for n, v in inputs.items()}
+        return jax.jit(
+            step,
+            in_shardings=(p_sh, a_sh, None, in_sh, None, None),
+            out_shardings=(p_sh, a_sh, None, None),
+            donate_argnums=(0, 1, 2))
+
+    # ------------------------------------------------------------ run
+    def __call__(self, inputs, rng=None, lr=0.01):
+        """Run one step on a global batch.
+
+        inputs: dict name -> array (host or device); returns the list
+        of output arrays (replicated loss heads / sharded outputs).
+        """
+        if rng is None:
+            from .. import random_state
+            rng = random_state.next_key()
+        vals = {n: jnp.asarray(v) if not isinstance(v, jax.Array)
+                else v for n, v in inputs.items()}
+        if self._step is None:
+            self._step = self._build(vals)
+        vals = {n: jax.device_put(v, self._in_shard(v.ndim))
+                for n, v in vals.items()}
+        self.params, self.aux, self.opt_state, outs = self._step(
+            self.params, self.aux, self.opt_state, vals, rng,
+            jnp.asarray(lr, jnp.float32))
+        return outs
+
+    def evaluate(self, inputs, rng=None):
+        """Compiled inference forward over the mesh (score/predict)."""
+        if rng is None:
+            from .. import random_state
+            rng = random_state.next_key()
+        run = self._run
+        if self._eval is None:
+            def ev(params, aux, inputs, rng):
+                merged = dict(inputs)
+                merged.update(params)
+                outs, _ = run(merged, aux, rng, False)
+                return outs
+            self._eval = jax.jit(ev)
+        vals = {n: jax.device_put(jnp.asarray(v),
+                                  self._in_shard(jnp.asarray(v).ndim))
+                for n, v in inputs.items()}
+        return self._eval(self.params, self.aux, vals, rng)
+
+    # ------------------------------------------------------------ values
+    @property
+    def input_names(self):
+        """Per-batch graph inputs (data + label variable names)."""
+        return self._input_names
+
+    def owned_values(self):
+        """(params, aux) copies safe to hand to external holders —
+        the step's own buffers are donated next call."""
+        return _copy_tree(self.params), _copy_tree(dict(self.aux))
+
+    def set_values(self, param_vals, aux_vals):
+        """Replace the step's device values (e.g. after an external
+        eager update touched the frontend's copies)."""
+        rep = {n: replicated(self.mesh) for n in param_vals}
+        self.params = _owned_put_tree(dict(param_vals), rep)
+        arep = {n: replicated(self.mesh) for n in aux_vals}
+        self.aux = _owned_put_tree(dict(aux_vals), arep)
