@@ -84,10 +84,15 @@ class KVStore:
                 dst._data = src._data
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        """Pull only the rows in row_ids (ref: kvstore.py:289) — the
-        embedding-scale path; full sharded-gather arrives with the
-        sparse milestone, semantics (dense gather) already hold."""
+        """Pull only the rows in row_ids (ref: kvstore.py:289).
+
+        O(k) like the reference's server-side row gather (ref:
+        src/kvstore/kvstore_dist_server.h:212): a row-sparse ``out``
+        receives just (rows, row_ids) buffers; a dense ``out`` (legacy
+        callers) receives the scatter of those rows."""
         import jax.numpy as jnp
+        from .ndarray.sparse import RowSparseNDArray
+        from .ndarray.ndarray import NDArray as _ND
         for k, o in self._pairs(key, out):
             src = self._store.get(k)
             if src is None:
@@ -97,9 +102,22 @@ class KVStore:
                 else [row_ids] * len(outs)
             for dst, rid in zip(outs, rids):
                 idx = rid._data.astype(jnp.int32)
-                rows = jnp.take(src._data, idx, axis=0)
-                full = jnp.zeros_like(src._data).at[idx].set(rows)
-                dst._data = full
+                if isinstance(dst, RowSparseNDArray):
+                    # dedup: batch row ids repeat (embedding lookups),
+                    # and a row-sparse array scatter-ADDs duplicates
+                    # on densify — store each row once
+                    import numpy as _n
+                    uniq = _n.unique(_n.asarray(idx))
+                    uidx = jnp.asarray(uniq, jnp.int32)
+                    dst._sp_data = _ND(jnp.take(src._data, uidx,
+                                                axis=0))
+                    dst._sp_indices = _ND(jnp.asarray(uniq))
+                    dst._dense_cache = None
+                    dst._sp_stale = False
+                else:
+                    rows = jnp.take(src._data, idx, axis=0)
+                    full = jnp.zeros_like(src._data).at[idx].set(rows)
+                    dst._data = full
 
     # ------------------------------------------------------------ optimizer
     def set_updater(self, updater):

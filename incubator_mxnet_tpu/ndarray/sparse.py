@@ -2,13 +2,13 @@
 storage-type enum; python/mxnet/ndarray/sparse.py).
 
 TPU-native design: XLA has no native sparse tensors, so CSR and
-row-sparse arrays are *structured dense* — index + value buffers with
-fixed capacity, the design SURVEY.md §7 stage 12 calls for.  Kernels
-(dot, elemwise) consume the structure directly with gather/scatter;
-``cast_storage`` converts to/from dense.
-
-Round-1 scope: construction, dense conversion, data access; sparse
-kernels arrive with the sparse milestone.
+row-sparse arrays hold only their index + value buffers — **no dense
+mirror is materialized at construction**.  Kernels (dot, retain,
+elemwise_add, the lazy optimizer updates) consume the buffers
+directly with gather/segment-sum, which tile cleanly onto the
+VPU/MXU; a dense view is built lazily (and cached) only when a
+dense-only consumer reads ``._data``.  ``cast_storage`` converts
+explicitly.
 """
 import numpy as np
 
@@ -21,18 +21,70 @@ __all__ = ["CSRNDArray", "RowSparseNDArray", "csr_matrix",
 
 
 class BaseSparseNDArray(NDArray):
-    pass
+    """Shared lazy-densify machinery.
+
+    ``_data`` is a *property*: reading it materializes (and caches)
+    the dense view for dense-only code paths; writing it (mutation,
+    kvstore.pull into a sparse out) stores the dense value and marks
+    the sparse buffers for lazy recomputation.
+    """
+
+    def __init__(self, shape, ctx=None):
+        self._sp_shape = tuple(int(s) for s in shape)
+        self._ctx = ctx
+        self._dense_cache = None
+        self._sp_stale = False
+
+    # -- NDArray surface without densification --------------------------
+    @property
+    def shape(self):
+        return self._sp_shape
+
+    @property
+    def size(self):
+        n = 1
+        for s in self._sp_shape:
+            n *= s
+        return n
+
+    @property
+    def ndim(self):
+        return len(self._sp_shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._sp_data.dtype if not self._sp_stale
+                        else self._dense_cache.dtype)
+
+    @property
+    def _data(self):
+        if self._dense_cache is None:
+            self._dense_cache = self._todense_impl()
+        return self._dense_cache
+
+    @_data.setter
+    def _data(self, value):
+        self._dense_cache = value
+        self._sp_stale = True
+
+    def _ensure_fresh(self):
+        if self._sp_stale:
+            self._refresh_from_dense(np.asarray(self._dense_cache))
+            self._sp_stale = False
+
+    def has_dense_mirror(self):
+        """True if a dense O(shape) buffer currently exists (tests)."""
+        return self._dense_cache is not None
 
 
 class CSRNDArray(BaseSparseNDArray):
     """Compressed sparse row matrix: data/indices/indptr buffers."""
 
     def __init__(self, data, indices, indptr, shape):
+        super().__init__(shape)
         self._sp_data = data            # NDArray (nnz,)
         self._sp_indices = indices      # NDArray (nnz,) int
         self._sp_indptr = indptr        # NDArray (rows+1,) int
-        self._sp_shape = tuple(shape)
-        super().__init__(self._todense_impl())
 
     @property
     def stype(self):
@@ -40,17 +92,29 @@ class CSRNDArray(BaseSparseNDArray):
 
     @property
     def data(self):
+        self._ensure_fresh()
         return self._sp_data
 
     @property
     def indices(self):
+        self._ensure_fresh()
         return self._sp_indices
 
     @property
     def indptr(self):
+        self._ensure_fresh()
         return self._sp_indptr
 
+    def _refresh_from_dense(self, dense):
+        fresh = _dense_to_csr(dense, self._sp_shape)
+        self._sp_data = fresh._sp_data
+        self._sp_indices = fresh._sp_indices
+        self._sp_indptr = fresh._sp_indptr
+        if hasattr(self, "_row_ids_cache"):
+            del self._row_ids_cache
+
     def _todense_impl(self):
+        self._ensure_fresh()
         indptr = np.asarray(self._sp_indptr._data)
         row_ids = np.repeat(np.arange(len(indptr) - 1),
                             np.diff(indptr))
@@ -68,15 +132,17 @@ class CSRNDArray(BaseSparseNDArray):
 
 
 class RowSparseNDArray(BaseSparseNDArray):
-    """Row-sparse tensor: a subset of rows is materialized."""
+    """Row-sparse tensor: a subset of rows is materialized.
+
+    Holds only ``data`` (k, *shape[1:]) and ``indices`` (k,) — the
+    design the reference uses server-side to avoid O(vocab) traffic
+    (ref: src/kvstore/kvstore_dist_server.h:212).
+    """
 
     def __init__(self, data, indices, shape):
+        super().__init__(shape)
         self._sp_data = data        # NDArray (k, *shape[1:])
         self._sp_indices = indices  # NDArray (k,) int row ids
-        self._sp_shape = tuple(shape)
-        dense = jnp.zeros(self._sp_shape, data._data.dtype).at[
-            indices._data.astype(jnp.int32)].set(data._data)
-        super().__init__(dense)
 
     @property
     def stype(self):
@@ -84,11 +150,28 @@ class RowSparseNDArray(BaseSparseNDArray):
 
     @property
     def data(self):
+        self._ensure_fresh()
         return self._sp_data
 
     @property
     def indices(self):
+        self._ensure_fresh()
         return self._sp_indices
+
+    def _refresh_from_dense(self, dense):
+        rows = np.nonzero(np.any(
+            dense.reshape(dense.shape[0], -1) != 0, axis=1))[0]
+        self._sp_data = _dense_array(dense[rows])
+        self._sp_indices = _dense_array(rows, dtype="int64")
+
+    def _todense_impl(self):
+        self._ensure_fresh()
+        # scatter-ADD so arrays whose index buffer carries duplicates
+        # (e.g. un-deduplicated gradients) still densify correctly
+        return jnp.zeros(
+            self._sp_shape, self._sp_data._data.dtype).at[
+            self._sp_indices._data.astype(jnp.int32)].add(
+            self._sp_data._data)
 
     def tostype(self, stype):
         if stype == "row_sparse":
@@ -151,9 +234,14 @@ def cast_storage(arr, stype):
 
 def zeros(stype, shape, ctx=None, dtype="float32"):
     if stype == "row_sparse":
-        return row_sparse_array(np.zeros(shape, dtype))
+        return RowSparseNDArray(
+            _dense_array(np.zeros((0,) + tuple(shape[1:]), dtype)),
+            _dense_array(np.zeros((0,), np.int64)), shape)
     if stype == "csr":
-        return _dense_to_csr(np.zeros(shape, dtype), shape)
+        return CSRNDArray(
+            _dense_array(np.zeros((0,), dtype)),
+            _dense_array(np.zeros((0,), np.int64)),
+            _dense_array(np.zeros((shape[0] + 1,), np.int64)), shape)
     from .ndarray import zeros as dzeros
     return dzeros(shape, ctx, dtype)
 
@@ -168,6 +256,7 @@ def zeros(stype, shape, ctx=None, dtype="float32"):
 
 def _csr_row_ids(csr):
     """Expand indptr to one row id per nonzero (host-side, cached)."""
+    csr._ensure_fresh()
     if not hasattr(csr, "_row_ids_cache"):
         indptr = np.asarray(csr._sp_indptr._data)
         counts = np.diff(indptr)
@@ -176,14 +265,17 @@ def _csr_row_ids(csr):
     return csr._row_ids_cache
 
 
-def dot(lhs, rhs, transpose_a=False):
+def dot(lhs, rhs, transpose_a=False, forward_stype=None):
     """Sparse-aware dot (ref: dot.cc dot(csr,dense)/dot(csr.T,dense)).
 
-    dot(csr, dense) -> dense; dot(csr.T, dense) -> dense (the
-    embedding-gradient shape); dot(rowsparse, dense) -> dense;
-    otherwise falls back to dense dot."""
+    dot(csr, dense) -> dense; dot(csr.T, dense) -> dense, or
+    row-sparse over the batch's touched columns when
+    ``forward_stype='row_sparse'`` (the embedding-gradient path, ref:
+    src/operator/tensor/dot.cc DotCsrTDnsRspImpl);
+    dot(rowsparse, dense) -> dense; otherwise dense dot."""
     import jax
     if isinstance(lhs, CSRNDArray):
+        lhs._ensure_fresh()
         vals = lhs._sp_data._data
         cols = lhs._sp_indices._data.astype(jnp.int32)
         rows = _csr_row_ids(lhs)
@@ -194,6 +286,15 @@ def dot(lhs, rhs, transpose_a=False):
             contrib = vals[:, None] * jnp.take(d, cols, axis=0)
             out = jax.ops.segment_sum(contrib, rows,
                                       num_segments=n_rows)
+        elif forward_stype == "row_sparse":
+            # only the columns this batch touched get a (nonzero) row
+            uniq, inv = jnp.unique(cols, return_inverse=True)
+            contrib = vals[:, None] * jnp.take(d, rows, axis=0)
+            out_rows = jax.ops.segment_sum(
+                contrib, inv.reshape(-1),
+                num_segments=int(uniq.shape[0]))
+            return RowSparseNDArray(NDArray(out_rows), NDArray(uniq),
+                                    (n_cols, d.shape[1]))
         else:
             # out[c] += vals * d[rows]  (scatter-add over columns)
             contrib = vals[:, None] * jnp.take(d, rows, axis=0)
@@ -201,6 +302,7 @@ def dot(lhs, rhs, transpose_a=False):
                 cols].add(contrib)
         return NDArray(out)
     if isinstance(lhs, RowSparseNDArray) and not transpose_a:
+        lhs._ensure_fresh()
         idx = lhs._sp_indices._data.astype(jnp.int32)
         out = jnp.zeros((lhs._sp_shape[0], rhs._data.shape[1]),
                         rhs._data.dtype)
@@ -212,28 +314,57 @@ def dot(lhs, rhs, transpose_a=False):
 
 def retain(data, indices):
     """Keep only the requested rows of a row-sparse array (ref:
-    src/operator/tensor/sparse_retain.cc)."""
+    src/operator/tensor/sparse_retain.cc).
+
+    Rows absent from ``data`` come back zero; duplicate indices in
+    the *stored* buffer sum (matching the array's scatter-add dense
+    semantics).  Index arithmetic is vectorized numpy on the (small)
+    index buffers; the values move through one device gather +
+    segment-sum — no dense buffer, no per-row Python loop."""
     assert isinstance(data, RowSparseNDArray), "retain needs row_sparse"
-    want = indices._data.astype(jnp.int32) if isinstance(
-        indices, NDArray) else jnp.asarray(indices, jnp.int32)
-    rows = jnp.take(data._data, want, axis=0)
-    return RowSparseNDArray(NDArray(rows), NDArray(want),
-                            data._sp_shape)
+    import jax
+    data._ensure_fresh()
+    want_np = np.asarray(
+        indices._data if isinstance(indices, NDArray) else indices,
+        np.int64)
+    want_sorted = np.sort(want_np)
+    unsort = np.argsort(np.argsort(want_np, kind="stable"),
+                        kind="stable")
+    have = np.asarray(data._sp_indices._data, np.int64)
+    k = len(want_np)
+    # map each stored entry to its wanted slot (k = "absent" bin)
+    pos = np.searchsorted(want_sorted, have)
+    valid = (pos < k) & (want_sorted[np.minimum(pos, k - 1)] == have) \
+        if k else np.zeros_like(have, bool)
+    seg = np.where(valid, pos, k)
+    vals = data._sp_data._data
+    summed = jax.ops.segment_sum(
+        vals, jnp.asarray(seg, jnp.int32), num_segments=k + 1)[:k]
+    rows = jnp.take(summed, jnp.asarray(unsort, jnp.int32), axis=0)
+    return RowSparseNDArray(
+        NDArray(rows), _dense_array(want_np, dtype="int64"),
+        data._sp_shape)
 
 
 def elemwise_add(lhs, rhs):
-    """row_sparse + row_sparse -> row_sparse.  Stays on device: the
-    result's index set is the (fixed-capacity) concatenation of both
-    index sets — duplicates are harmless because reconstruction
-    writes the same summed row for each copy."""
+    """row_sparse + row_sparse -> row_sparse with the sorted-unique
+    union index set, via segment-sum over O(nnz) buffers — no dense
+    mirror (ref: src/operator/tensor/elemwise_binary_op_basic.cc
+    rsp+rsp path)."""
     if isinstance(lhs, RowSparseNDArray) and \
             isinstance(rhs, RowSparseNDArray):
-        dense = lhs._data + rhs._data
-        idx = jnp.concatenate([
-            lhs._sp_indices._data.astype(jnp.int32),
-            rhs._sp_indices._data.astype(jnp.int32)])
-        rows = jnp.take(dense, idx, axis=0)
-        return RowSparseNDArray(NDArray(rows), NDArray(idx),
+        lhs._ensure_fresh()
+        rhs._ensure_fresh()
+        li = lhs._sp_indices._data.astype(jnp.int32)
+        ri = rhs._sp_indices._data.astype(jnp.int32)
+        all_idx = jnp.concatenate([li, ri])
+        uniq, inv = jnp.unique(all_idx, return_inverse=True)
+        vals = jnp.concatenate([lhs._sp_data._data,
+                                rhs._sp_data._data], axis=0)
+        import jax
+        summed = jax.ops.segment_sum(vals, inv.reshape(-1),
+                                     num_segments=int(uniq.shape[0]))
+        return RowSparseNDArray(NDArray(summed), NDArray(uniq),
                                 lhs._sp_shape)
     return NDArray(lhs._data + rhs._data)
 
@@ -246,6 +377,7 @@ def sgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0,
     """Lazy SGD: only rows present in the row-sparse grad are updated
     (ref: optimizer_op.cc sparse sgd_update alias — 'lazy update')."""
     if isinstance(grad, RowSparseNDArray):
+        grad._ensure_fresh()
         idx = grad._sp_indices._data.astype(jnp.int32)
         g = grad._sp_data._data * rescale_grad
         if clip_gradient is not None:
@@ -273,6 +405,7 @@ def adam_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
     coef2 = 1.0 - beta2 ** t
     lr_t = lr * (coef2 ** 0.5) / coef1
     if isinstance(grad, RowSparseNDArray):
+        grad._ensure_fresh()
         idx = grad._sp_indices._data.astype(jnp.int32)
         g = grad._sp_data._data * rescale_grad
         if clip_gradient is not None:
