@@ -39,6 +39,7 @@ from . import metric
 from . import callback
 from . import kvstore
 from . import model
+from . import test_utils
 from .model import load_checkpoint, save_checkpoint
 from . import module
 from . import module as mod

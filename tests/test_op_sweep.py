@@ -1,0 +1,288 @@
+"""Registry-wide operator sweep (VERDICT r2 task 5): every
+differentiable op gets a numeric-gradient check through the symbolic
+executor (the reference's per-op check_numeric_gradient discipline,
+ref: python/mxnet/test_utils.py:789 used across
+tests/python/unittest/test_operator.py), and non-differentiable /
+custom-VJP ops get a forward execution check.
+
+The sweep runs per unique compute function; the meta test at the
+bottom asserts the swept functions cover >150 registry names.
+"""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import test_utils as tu
+from incubator_mxnet_tpu.ops.registry import OPS
+
+RS = np.random.RandomState(7)
+
+
+def P(*shape, lo=0.3, hi=0.9, dtype=np.float32):
+    """Positive floats inside every unary op's domain (log, sqrt,
+    arcsin, erfinv ... all defined on (0.3, 0.9))."""
+    return (RS.uniform(lo, hi, shape)).astype(dtype)
+
+
+def S(*shape):  # symmetric positive definite
+    a = RS.rand(*shape).astype(np.float32)
+    return a @ a.T + np.eye(shape[0], dtype=np.float32) * shape[0]
+
+
+# ---------------------------------------------------------------------------
+# specs: name -> dict(inputs=[...], params={}, fwd=bool)
+# default (no spec): n_args inputs of shape (2,3) in (0.3,0.9),
+# numeric-gradient checked when op.differentiable
+# ---------------------------------------------------------------------------
+
+TRI = np.tril(RS.rand(3, 3).astype(np.float32) + 0.5)
+
+SPECS = {
+    # ---- scalar-arg elemwise
+    **{n: dict(inputs=[P(2, 3)], params=dict(scalar=0.7))
+       for n in ["_plus_scalar", "_minus_scalar", "_rminus_scalar",
+                 "_mul_scalar", "_div_scalar", "_rdiv_scalar",
+                 "_power_scalar", "_rpower_scalar", "_hypot_scalar",
+                 "_maximum_scalar", "_minimum_scalar"]},
+    **{n: dict(inputs=[P(2, 3)], params=dict(scalar=0.7), fwd=True)
+       for n in ["_mod_scalar", "_rmod_scalar", "_equal_scalar",
+                 "_not_equal_scalar", "_greater_scalar",
+                 "_greater_equal_scalar", "_lesser_scalar",
+                 "_lesser_equal_scalar"]},
+    "arccosh": dict(inputs=[P(2, 3, lo=1.3, hi=2.0)]),
+    "clip": dict(inputs=[P(2, 3)], params=dict(a_min=0.4, a_max=0.8)),
+    "smooth_l1": dict(inputs=[P(2, 3)]),
+    # ---- shape manipulation
+    "reshape": dict(inputs=[P(2, 6)], params=dict(shape=(3, 4))),
+    "expand_dims": dict(inputs=[P(2, 3)], params=dict(axis=1)),
+    "squeeze": dict(inputs=[P(2, 1, 3)]),
+    "transpose": dict(inputs=[P(2, 3)]),
+    "swapaxes": dict(inputs=[P(2, 3, 4)],
+                     params=dict(dim1=0, dim2=2)),
+    "tile": dict(inputs=[P(2, 3)], params=dict(reps=(2, 2))),
+    "repeat": dict(inputs=[P(2, 3)], params=dict(repeats=2)),
+    "pad": dict(inputs=[P(1, 2, 3, 3)],
+                params=dict(mode="constant",
+                            pad_width=(0, 0, 0, 0, 1, 1, 1, 1))),
+    "flip": dict(inputs=[P(2, 3)], params=dict(axis=0)),
+    "reverse": dict(inputs=[P(2, 3)], params=dict(axis=1)),
+    "slice": dict(inputs=[P(4, 4)],
+                  params=dict(begin=(1, 0), end=(3, 2))),
+    "slice_axis": dict(inputs=[P(4, 4)],
+                       params=dict(axis=1, begin=0, end=2)),
+    "slice_like": dict(inputs=[P(4, 4), P(2, 3)]),
+    "broadcast_to": dict(inputs=[P(1, 3)], params=dict(shape=(4, 3))),
+    "broadcast_axis": dict(inputs=[P(1, 3)],
+                           params=dict(axis=0, size=4)),
+    "broadcast_like": dict(inputs=[P(1, 3), P(4, 3)]),
+    "stack": dict(inputs=[P(2, 3), P(2, 3)], params=dict(axis=0)),
+    "concat": dict(inputs=[P(2, 3), P(2, 3)], params=dict(dim=1)),
+    "split": dict(inputs=[P(4, 6)],
+                  params=dict(num_outputs=2, axis=1)),
+    "where": dict(inputs=[(RS.rand(2, 3) > 0.5).astype(np.float32),
+                          P(2, 3), P(2, 3)]),
+    "one_hot": dict(inputs=[np.array([0, 2, 1], np.int32)],
+                    params=dict(depth=4), fwd=True),
+    # ---- matmul / linalg
+    "dot": dict(inputs=[P(2, 3), P(3, 4)]),
+    "batch_dot": dict(inputs=[P(2, 2, 3), P(2, 3, 2)]),
+    "khatri_rao": dict(inputs=[P(2, 3), P(4, 3)]),
+    "linalg_gemm": dict(inputs=[P(2, 3), P(3, 4), P(2, 4)]),
+    "linalg_gemm2": dict(inputs=[P(2, 3), P(3, 4)]),
+    "linalg_syrk": dict(inputs=[P(3, 4)]),
+    "linalg_potrf": dict(inputs=[S(3, 3)], rtol=0.08),
+    "linalg_potri": dict(inputs=[S(3, 3)], rtol=0.08),
+    "linalg_sumlogdiag": dict(inputs=[S(3, 3)]),
+    "linalg_trmm": dict(inputs=[TRI, P(3, 3)]),
+    "linalg_trsm": dict(inputs=[TRI + np.eye(3, dtype=np.float32),
+                                P(3, 3)], rtol=0.08),
+    "linalg_gelqf": dict(inputs=[P(2, 3)], fwd=True),
+    "linalg_syevd": dict(inputs=[S(3, 3)], fwd=True),
+    # ---- indexing
+    "take": dict(inputs=[P(5, 3), np.array([0, 2], np.int32)]),
+    "batch_take": dict(inputs=[P(3, 4),
+                               np.array([0, 2, 1], np.int32)]),
+    "pick": dict(inputs=[P(3, 4), np.array([0, 2, 1], np.float32)],
+                 grad_nodes=["a0"]),
+    "gather_nd": dict(inputs=[P(3, 4),
+                              np.array([[0, 2], [1, 3]], np.int32)]),
+    "scatter_nd": dict(
+        inputs=[P(2), np.array([[0, 2], [1, 3]], np.int32)],
+        params=dict(shape=(3, 4))),
+    "Embedding": dict(inputs=[np.array([0, 2], np.int32), P(5, 4)],
+                      params=dict(input_dim=5, output_dim=4)),
+    # ---- reductions with axes
+    "max_axis": dict(inputs=[P(3, 4)], params=dict(axis=1)),
+    "min_axis": dict(inputs=[P(3, 4)], params=dict(axis=1)),
+    "sum_axis": dict(inputs=[P(3, 4)], params=dict(axis=1)),
+    "argmax": dict(inputs=[P(3, 4)], params=dict(axis=1), fwd=True),
+    "argmin": dict(inputs=[P(3, 4)], params=dict(axis=1), fwd=True),
+    "argmax_channel": dict(inputs=[P(3, 4)], fwd=True),
+    "argsort": dict(inputs=[P(3, 4)], fwd=True),
+    "sort": dict(inputs=[P(3, 4)], fwd=True),
+    "topk": dict(inputs=[P(3, 4)], params=dict(k=2), fwd=True),
+    "norm": dict(inputs=[P(2, 3)]),
+    # ---- nn layers
+    "FullyConnected": dict(inputs=[P(2, 3), P(4, 3), P(4)],
+                           params=dict(num_hidden=4)),
+    "Convolution": dict(
+        inputs=[P(1, 2, 5, 5), P(3, 2, 3, 3), P(3)],
+        params=dict(kernel=(3, 3), num_filter=3), rtol=0.08),
+    "Deconvolution": dict(
+        inputs=[P(1, 2, 4, 4), P(2, 3, 3, 3), P(3)],
+        params=dict(kernel=(3, 3), num_filter=3, no_bias=False),
+        rtol=0.08),
+    "Pooling": dict(inputs=[P(1, 2, 4, 4)],
+                    params=dict(kernel=(2, 2), stride=(2, 2),
+                                pool_type="avg")),
+    "UpSampling": dict(inputs=[P(1, 2, 3, 3)],
+                       params=dict(scale=2, sample_type="nearest")),
+    "LRN": dict(inputs=[P(1, 4, 3, 3)], params=dict(nsize=3)),
+    "LayerNorm": dict(inputs=[P(2, 4), P(4), P(4)]),
+    "InstanceNorm": dict(inputs=[P(2, 3, 4), P(3), P(3)]),
+    "L2Normalization": dict(inputs=[P(2, 3, 4)]),
+    "Activation": dict(inputs=[P(2, 3)],
+                       params=dict(act_type="tanh")),
+    "LeakyReLU": dict(inputs=[P(2, 3)]),
+    "softmax": dict(inputs=[P(2, 4)]),
+    "log_softmax": dict(inputs=[P(2, 4)]),
+    "softmax_cross_entropy": dict(
+        inputs=[P(3, 4), np.array([0, 2, 1], np.float32)],
+        grad_nodes=["a0"]),
+    "SequenceMask": dict(inputs=[P(3, 2, 4)]),
+    "SequenceLast": dict(inputs=[P(3, 2, 4)]),
+    "SequenceReverse": dict(inputs=[P(3, 2, 4)]),
+    "SliceChannel": dict(inputs=[P(2, 4)],
+                         params=dict(num_outputs=2, axis=1)),
+    "Flatten": dict(inputs=[P(2, 3, 4)]),
+    "Cast": dict(inputs=[P(2, 3)], params=dict(dtype="float32"),
+                 fwd=True),
+    "Crop": dict(inputs=[P(1, 2, 4, 4)],
+                 params=dict(h_w=(2, 2), offset=(1, 1))),
+    "GridGenerator": dict(
+        inputs=[np.array([[1, 0, 0, 0, 1, 0]], np.float32)],
+        params=dict(transform_type="affine", target_shape=(3, 3))),
+    "BilinearSampler": dict(
+        inputs=[P(1, 2, 4, 4),
+                (RS.rand(1, 2, 3, 3) * 0.8 - 0.4).astype(np.float32)],
+        rtol=0.08),
+    # off-lattice affine: bilinear grads are discontinuous exactly
+    # on integer sample coords, so keep them strictly interior
+    "SpatialTransformer": dict(
+        inputs=[P(1, 2, 4, 4),
+                np.array([[0.45, 0, 0.05, 0, 0.45, 0.05]],
+                         np.float32)],
+        params=dict(target_shape=(3, 3)), rtol=0.08),
+    "ROIPooling": dict(
+        inputs=[P(1, 2, 6, 6),
+                np.array([[0, 0, 0, 3, 3]], np.float32)],
+        params=dict(pooled_size=(2, 2), spatial_scale=1.0),
+        grad_nodes=["a0"], fwd=True),
+    # ---- heads with custom-VJP loss backward: forward-only (their
+    # backward is the *loss* gradient, not d(forward) — by design)
+    **{n: dict(inputs=[P(3, 4), np.array([0, 2, 1], np.float32)],
+               fwd=True)
+       for n in ["SoftmaxOutput", "SVMOutput",
+                 "LinearRegressionOutput", "MAERegressionOutput",
+                 "LogisticRegressionOutput"]},
+    "make_loss": dict(inputs=[P(2, 3)], fwd=True),
+    "BlockGrad": dict(inputs=[P(2, 3)], fwd=True),
+    "stop_gradient": dict(inputs=[P(2, 3)], fwd=True),
+    "_identity_with_attr_like_rhs": dict(inputs=[P(2, 3), P(2, 3)],
+                                         fwd=True),
+    "elemwise_addto": dict(inputs=[P(2, 3), P(2, 3)], fwd=True),
+    # comparisons / mod: derivative zero or undefined -> forward-only
+    **{n: dict(inputs=[P(2, 3), P(2, 3)], fwd=True)
+       for n in ["_equal", "_not_equal", "_greater", "_greater_equal",
+                 "_lesser", "_lesser_equal", "_mod",
+                 "broadcast_equal", "broadcast_not_equal",
+                 "broadcast_greater", "broadcast_greater_equal",
+                 "broadcast_lesser", "broadcast_lesser_equal",
+                 "broadcast_mod", "broadcast_logical_and",
+                 "broadcast_logical_or", "broadcast_logical_xor"]},
+    "add_n": dict(inputs=[P(2, 3), P(2, 3)]),
+    "ElementWiseSum": dict(inputs=[P(2, 3), P(2, 3)]),
+}
+
+SKIP = set(
+    # random / sampling (distributional, tested in test_operator)
+    [n for n in OPS if "random" in n or "sample" in n
+     or n in ("normal", "uniform", "shuffle", "_shuffle")]
+    # optimizer update kernels (tested in test_optimizer)
+    + [n for n in OPS if n.endswith("_update")]
+    # init / constant ops (no tensor input)
+    + ["_zeros", "_ones", "_eye", "_full", "_arange", "zeros_like",
+       "ones_like"]
+    # aux-state / rng / recurrent ops covered by dedicated suites
+    + ["BatchNorm", "BatchNorm_v1", "CuDNNBatchNorm", "Dropout",
+       "RNN", "Custom", "CTCLoss", "ctc_loss", "_contrib_CTCLoss",
+       "_contrib_ctc_loss"]
+    # contrib detection ops: tests/test_contrib_det.py
+    + [n for n in OPS if n.startswith("_contrib_")]
+    # sparse kernels: tests/test_sparse*.py
+    + [n for n in OPS if n.startswith("_sparse_")]
+    # in-place assignment / device plumbing / misc utilities
+    + ["_slice_assign", "_slice_assign_scalar", "_crop_assign",
+       "_crop_assign_scalar", "_scatter_set_nd", "_CrossDeviceCopy",
+       "_cross_device_copy", "amp_cast", "cast", "crop",
+       "broadcast_axes"])
+
+
+def _build_cases():
+    cases = {}
+    seen_fns = set()
+    # spec'd names first so aliases of spec'd ops dedupe onto them
+    order = [n for n in SPECS if n in OPS] + \
+        [n for n in sorted(OPS) if n not in SPECS]
+    for name in order:
+        op = OPS[name]
+        if name in SKIP or id(op.fn) in seen_fns:
+            continue
+        spec = SPECS.get(name)
+        if spec is None:
+            n_in = len(op.arg_names) or 1
+            if n_in > 3:
+                continue
+            spec = dict(inputs=[P(2, 3) for _ in range(n_in)])
+        seen_fns.add(id(op.fn))
+        cases[name] = spec
+    return cases
+
+
+CASES = _build_cases()
+
+
+def _symbol_for(name, spec):
+    op_fn = getattr(mx.sym, name, None) or \
+        getattr(mx.sym._internal, name)
+    variables = [mx.sym.Variable(f"a{i}")
+                 for i in range(len(spec["inputs"]))]
+    return op_fn(*variables, **spec.get("params", {}))
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_op_sweep(name):
+    spec = CASES[name]
+    sym = _symbol_for(name, spec)
+    location = {f"a{i}": v for i, v in enumerate(spec["inputs"])}
+    op = OPS[name]
+    fwd_only = spec.get("fwd", False) or not op.differentiable
+    if fwd_only:
+        exe, _ = tu._bind(sym, location, grad_req="null")
+        outs = exe.forward(is_train=False)
+        for o in outs:
+            a = o.asnumpy()
+            assert np.all(np.isfinite(a.astype(np.float64))), name
+    else:
+        tu.check_numeric_gradient(
+            sym, location, numeric_eps=1e-3,
+            rtol=spec.get("rtol", 0.05), atol=spec.get("atol", 5e-3),
+            grad_nodes=spec.get("grad_nodes"))
+
+
+def test_sweep_covers_registry():
+    """The swept compute functions must cover >150 registry names
+    (aliases included), per the round-2 verdict's bar."""
+    swept_fns = {id(OPS[n].fn) for n in CASES}
+    covered = [n for n in OPS if id(OPS[n].fn) in swept_fns]
+    assert len(covered) > 150, (len(covered), len(CASES))
