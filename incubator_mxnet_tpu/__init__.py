@@ -40,6 +40,7 @@ from . import callback
 from . import kvstore
 from . import model
 from . import test_utils
+from . import dist
 from .model import load_checkpoint, save_checkpoint
 from . import module
 from . import module as mod

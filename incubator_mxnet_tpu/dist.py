@@ -1,0 +1,108 @@
+"""Multi-process bootstrap and cross-process collectives.
+
+Role analog of the reference's ps-lite rendezvous + dist kvstore
+transport (ref: tools/launch.py:64-83 spawning workers/servers with
+DMLC_* env vars; src/kvstore/kvstore_dist.h:49 push/pull to servers).
+
+TPU-native design: there are no parameter servers — processes join a
+single JAX distributed runtime (`jax.distributed.initialize`, the
+coordinator replacing the ps-lite scheduler) and gradient exchange is
+a collective over all processes' devices (gloo on CPU hosts, ICI/DCN
+on TPU pods).  The launcher (tools/launch.py here) sets the env
+contract:
+
+    MXTPU_NUM_WORKERS   number of worker processes
+    MXTPU_WORKER_RANK   this process's rank
+    MXTPU_COORD_ADDR    host:port of rank 0 (the coordinator)
+
+`init()` is idempotent and a no-op for single-process runs, so the
+same training script works launched directly or under the launcher —
+the reference's `kv.num_workers`-driven behavior carries over.
+"""
+import os
+
+__all__ = ["init", "is_initialized", "rank", "num_workers",
+           "allreduce_sum", "broadcast", "barrier"]
+
+_initialized = False
+
+
+def env_num_workers():
+    return int(os.environ.get("MXTPU_NUM_WORKERS", "1"))
+
+
+def is_initialized():
+    return _initialized
+
+
+def init(coordinator_address=None, num_workers_=None, rank_=None):
+    """Join the distributed runtime (idempotent).
+
+    Arguments default to the launcher's env contract; returns the
+    process rank.  Single-process (no env, no args) is a no-op.
+    """
+    global _initialized
+    import jax
+    if _initialized:
+        return jax.process_index()
+    n = num_workers_ if num_workers_ is not None else env_num_workers()
+    if n <= 1:
+        return 0
+    r = rank_ if rank_ is not None else \
+        int(os.environ.get("MXTPU_WORKER_RANK", "0"))
+    coord = coordinator_address or os.environ.get("MXTPU_COORD_ADDR")
+    if coord is None:
+        raise RuntimeError(
+            "MXTPU_NUM_WORKERS>1 but no MXTPU_COORD_ADDR; launch "
+            "through tools/launch.py or pass coordinator_address")
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=n, process_id=r)
+    _initialized = True
+    return r
+
+
+def rank():
+    import jax
+    return jax.process_index()
+
+
+def num_workers():
+    import jax
+    return jax.process_count()
+
+
+def allreduce_sum(value):
+    """Sum ``value`` (array or pytree) across all processes.
+
+    Results are re-wrapped as jax Arrays (multihost_utils fetches to
+    host numpy; callers store these into NDArray._data, whose
+    contract is a device array)."""
+    import jax
+    import jax.numpy as jnp
+    if jax.process_count() == 1:
+        return value
+    from jax.experimental import multihost_utils
+
+    def red(v):
+        gathered = multihost_utils.process_allgather(v)
+        return jnp.asarray(gathered.sum(axis=0))
+    return jax.tree_util.tree_map(red, value)
+
+
+def broadcast(value, root=0):
+    """Every process receives ``root``'s value (array or pytree)."""
+    import jax
+    import jax.numpy as jnp
+    if jax.process_count() == 1:
+        return value
+    from jax.experimental import multihost_utils
+    out = multihost_utils.broadcast_one_to_all(
+        value, is_source=jax.process_index() == root)
+    return jax.tree_util.tree_map(jnp.asarray, out)
+
+
+def barrier(tag="mxtpu_barrier"):
+    import jax
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(tag)

@@ -46,16 +46,30 @@ class KVStore:
 
     def init(self, key, value):
         """Initialize key(s) with initial weight(s)
-        (ref: kvstore.py init:96)."""
+        (ref: kvstore.py init:96).  Multi-process: rank 0's value is
+        broadcast so every worker starts from identical weights (the
+        reference's server-side init, ref: kvstore_dist.h Init)."""
+        from . import dist
+        multi = self.type == "tpu" and self.num_workers > 1
         for k, v in self._pairs(key, value):
             if k in self._store:
                 continue
             vv = v[0] if isinstance(v, (list, tuple)) else v
-            self._store[k] = vv.copy()
+            if multi:
+                self._store[k] = NDArray(dist.broadcast(vv._data),
+                                         vv.context)
+            else:
+                self._store[k] = vv.copy()
 
     def push(self, key, value, priority=0):
-        """Push gradient(s); aggregates replicas and runs the updater
-        if one is set (ref: kvstore.py push:140)."""
+        """Push gradient(s); aggregates replicas — and, multi-process,
+        allreduces across workers (the reference's send-to-server +
+        server-side sum, ref: kvstore_dist.h Push / comm.h reduce) —
+        then runs the updater if one is set (ref: kvstore.py
+        push:140).  Every worker applies the identical summed
+        gradient, so replicas stay consistent without a server."""
+        from . import dist
+        multi = self.type == "tpu" and self.num_workers > 1
         for k, v in self._pairs(key, value):
             vals = v if isinstance(v, (list, tuple)) else [v]
             merged = vals[0]
@@ -63,6 +77,9 @@ class KVStore:
                 merged = vals[0].copy()
                 for extra in vals[1:]:
                     merged += extra.as_in_context(merged.context)
+            if multi:
+                merged = NDArray(dist.allreduce_sum(merged._data),
+                                 merged.context)
             if self._updater is not None:
                 if k not in self._store:
                     raise KeyError(f"key {k} not initialized")
@@ -131,10 +148,8 @@ class KVStore:
 
     # ------------------------------------------------------------ dist API
     def barrier(self):
-        import jax
-        if jax.process_count() > 1:
-            from jax.experimental import multihost_utils
-            multihost_utils.sync_global_devices("kvstore_barrier")
+        from . import dist
+        dist.barrier("kvstore_barrier")
 
     def send_command_to_servers(self, head, body):
         pass  # no servers: command surface kept for API parity
@@ -176,8 +191,12 @@ def create(name="local"):
         return KVStore(name)
     if name in ("tpu", "dist_sync", "dist_device_sync", "dist_sync_device",
                 "nccl", "horovod"):
-        # in-step psum over the mesh does the reduction; store-side
-        # behavior is identical to local
+        # single-process: in-step psum over the mesh does the
+        # reduction.  Multi-process (launched via tools/launch.py):
+        # join the distributed runtime; push/pull then allreduce
+        # across workers.
+        from . import dist
+        dist.init()
         return KVStore("tpu")
     if name == "dist_async":
         raise ValueError(

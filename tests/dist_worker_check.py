@@ -1,0 +1,73 @@
+"""Worker script for the multi-process kvstore test — run under
+tools/launch.py (the reference's single-machine dist trick:
+tests/nightly/dist_sync_kvstore.py via tools/launch.py -n 2).
+
+Not a pytest module: tests/test_dist_launch.py spawns it.
+"""
+import sys
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import dist
+
+
+def main():
+    kv = mx.kvstore.create("dist_sync")
+    assert kv.num_workers == 2, kv.num_workers
+    r = kv.rank
+
+    # init: rank 0's proposal wins everywhere
+    kv.init(3, mx.nd.array(np.full((4,), r + 10.0, np.float32)))
+    out = mx.nd.zeros((4,))
+    kv.pull(3, out=out)
+    assert np.allclose(out.asnumpy(), 10.0), out.asnumpy()
+
+    # push aggregates across workers (no updater -> pull merged grad)
+    kv.push(3, mx.nd.array(np.full((4,), float(r + 1), np.float32)))
+    kv.pull(3, out=out)
+    assert np.allclose(out.asnumpy(), 3.0), out.asnumpy()
+
+    # updater path: every worker applies the same summed grad
+    opt = mx.optimizer.create("sgd", learning_rate=0.1,
+                              rescale_grad=1.0)
+    kv.set_optimizer(opt)
+    kv.push(3, mx.nd.array(np.full((4,), float(r + 1), np.float32)))
+    kv.pull(3, out=out)
+    assert np.allclose(out.asnumpy(), 9.7), out.asnumpy()
+    kv.barrier()
+
+    # end-to-end: Module.fit on rank-sharded data, replicas converge
+    # to identical parameters (the dist_sync contract)
+    rs = np.random.RandomState(0)  # same data both ranks; shard below
+    x = rs.rand(128, 10).astype(np.float32)
+    w = rs.rand(10, 5).astype(np.float32)
+    y = (x @ w).argmax(1).astype(np.float32)
+    shard_x, shard_y = x[r::2], y[r::2]
+    it = mx.io.NDArrayIter(shard_x, shard_y, batch_size=16,
+                           label_name="softmax_label")
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc", num_hidden=5)
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mx.random.seed(0)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=2, kvstore="dist_sync", optimizer="sgd",
+            optimizer_params=dict(learning_rate=0.5),
+            initializer=mx.initializer.Xavier())
+    arg, _ = mod.get_params()
+    flat = np.concatenate([v.asnumpy().ravel()
+                           for _, v in sorted(arg.items())])
+    assert np.all(np.isfinite(flat))
+    gathered = np.asarray(dist.allreduce_sum(
+        jax.numpy.asarray(flat)))  # sum == 2x each if identical
+    assert np.allclose(gathered, 2 * flat, rtol=1e-6), \
+        np.abs(gathered - 2 * flat).max()
+
+    print(f"DIST_OK rank {r}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Distributed job launcher (ref: tools/launch.py:64-83).
+
+Spawns N worker processes for data-parallel training.  Where the
+reference wires ps-lite (scheduler + servers + workers over DMLC_*
+env vars), this launcher wires the JAX distributed runtime: every
+worker gets the coordinator address of rank 0 and joins via
+`incubator_mxnet_tpu.dist.init()` (called automatically by
+`kvstore.create('dist_sync')`).
+
+Usage:
+    python tools/launch.py -n 2 python train.py --kv-store dist_sync
+
+Launch modes:
+    local (default) — N processes on this host (the reference's
+        `--launcher local` used by tests/nightly/dist_sync_kvstore.py)
+    ssh/mpi/sge/yarn — print the equivalent command per host; actual
+        remote spawning is environment-specific and out of scope here
+        (the reference shells out to ssh/mpirun the same way).
+
+`-s` (server count) is accepted for CLI parity and ignored: there are
+no parameter servers in the collective design.
+"""
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="Launch a distributed training job")
+    ap.add_argument("-n", "--num-workers", type=int, required=True,
+                    help="number of worker processes")
+    ap.add_argument("-s", "--num-servers", type=int, default=0,
+                    help="ignored (no parameter servers; kept for "
+                    "CLI parity with the reference)")
+    ap.add_argument("--launcher", default="local",
+                    choices=["local", "ssh", "mpi", "sge", "yarn"])
+    ap.add_argument("-H", "--hostfile", default=None,
+                    help="hostfile for ssh/mpi modes")
+    ap.add_argument("command", nargs=argparse.REMAINDER,
+                    help="training command")
+    args = ap.parse_args()
+    if not args.command:
+        ap.error("no command given")
+    cmd = args.command
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+
+    coord = f"127.0.0.1:{_free_port()}"
+    if args.launcher != "local":
+        print(f"# {args.launcher} mode: run on each host "
+              "(rank 0's host is the coordinator):")
+        for r in range(args.num_workers):
+            env = (f"MXTPU_NUM_WORKERS={args.num_workers} "
+                   f"MXTPU_WORKER_RANK={r} "
+                   f"MXTPU_COORD_ADDR=<rank0-host>:9999")
+            print(f"{env} {' '.join(cmd)}")
+        return 0
+
+    procs = []
+    try:
+        for r in range(args.num_workers):
+            env = dict(os.environ)
+            env["MXTPU_NUM_WORKERS"] = str(args.num_workers)
+            env["MXTPU_WORKER_RANK"] = str(r)
+            env["MXTPU_COORD_ADDR"] = coord
+            p = subprocess.Popen(cmd, env=env)
+            procs.append(p)
+        # poll all workers: one crashing mid-collective would leave
+        # its peers blocked forever, so the first failure tears the
+        # job down (the reference's ps-lite scheduler dies the same
+        # way when a worker drops)
+        import time
+        rc = 0
+        pending = dict(enumerate(procs))
+        while pending and rc == 0:
+            for r, p in list(pending.items()):
+                code = p.poll()
+                if code is None:
+                    continue
+                del pending[r]
+                if code != 0:
+                    print(f"launch.py: worker {r} exited with "
+                          f"{code}; terminating the job",
+                          file=sys.stderr)
+                    rc = code or 1
+            time.sleep(0.05)
+        return rc
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
