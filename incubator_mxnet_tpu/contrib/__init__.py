@@ -37,3 +37,20 @@ ndarray = _make_namespace(__name__ + ".ndarray", _nd_lookup)
 nd = ndarray
 symbol = _make_namespace(__name__ + ".symbol", _sym_lookup)
 sym = symbol
+
+# reference parity: the contrib ops are reachable both ways —
+# mx.contrib.nd.X and mx.nd.contrib.X (ref: python/mxnet/ndarray/
+# contrib.py / symbol/contrib.py)
+def _attach():
+    import sys as _sys
+    from .. import ndarray as _nd
+    from .. import symbol as _sym
+    _nd.contrib = ndarray
+    _sym.contrib = symbol
+    # `import incubator_mxnet_tpu.ndarray.contrib` must work as a
+    # statement too, like the reference's real submodules
+    _sys.modules[_nd.__name__ + ".contrib"] = ndarray
+    _sys.modules[_sym.__name__ + ".contrib"] = symbol
+
+
+_attach()
