@@ -41,6 +41,8 @@ from . import kvstore
 from . import model
 from . import test_utils
 from . import dist
+from . import predictor
+from .predictor import Predictor
 from .model import load_checkpoint, save_checkpoint
 from . import module
 from . import module as mod

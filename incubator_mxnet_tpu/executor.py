@@ -304,9 +304,12 @@ class Executor:
         shape-keyed jit cache, so this is just buffer reallocation."""
         shapes = {k: v.shape for k, v in self.arg_dict.items()}
         shapes.update(kwargs)
+        # preserve bound dtypes (int inputs, fp16/bf16 bindings)
+        type_dict = {k: v.dtype for k, v in self.arg_dict.items()}
+        type_dict.update({k: v.dtype for k, v in self.aux_dict.items()})
         return Executor._simple_bind(
             self._symbol, self._ctx,
-            self._grad_req, None, shapes, _copy_from=self)
+            self._grad_req, type_dict, shapes, _copy_from=self)
 
     @classmethod
     def _simple_bind(cls, symbol, ctx, grad_req, type_dict, shape_kwargs,

@@ -21,6 +21,7 @@ from .. import autograd, random_state
 from ..autograd import TapeNode
 from ..context import default_context
 from ..ndarray.ndarray import NDArray
+from ..symbol.symbol import Symbol
 from .parameter import (DeferredInitializationError, Parameter,
                         ParameterDict)
 
@@ -200,6 +201,10 @@ class HybridBlock(Block):
 
     # ------------------------------------------------------------ call
     def __call__(self, *args):
+        if any(isinstance(a, Symbol) for a in args):
+            # export tracing: children build graph nodes
+            return self._to_symbol(*args)
+        self._in_arity = len(args)
         if not self._active:
             return self.forward(*args)
         # inside an enclosing cache trace, inputs are tracers: run the
@@ -209,6 +214,38 @@ class HybridBlock(Block):
                                                      jax.core.Tracer):
                 return self.forward(*args)
         return self._call_cached(*args)
+
+    # ------------------------------------------------------------ export
+    def _to_symbol(self, *sym_inputs):
+        """Trace this block into a Symbol graph: own parameters become
+        named Variables, ops build graph nodes because every layer's
+        hybrid_forward goes through F (here the symbol frontend)."""
+        from .. import symbol as sym_mod
+        params = {self._strip(name): sym_mod.Variable(name)
+                  for name, p in self.params.items()}
+        return self.hybrid_forward(sym_mod, *sym_inputs, **params)
+
+    def export(self, path, epoch=0):
+        """Export the block as symbol JSON + params servable by
+        ``symbol.load`` + Executor / ``Predictor`` / ``Module.load``
+        (ref: python/mxnet/gluon/block.py HybridBlock.export).
+
+        Writes ``path-symbol.json`` and ``path-%04d.params``.  The
+        block must have run forward at least once (shapes settled).
+        """
+        from .. import symbol as sym_mod
+        from ..model import save_checkpoint
+        n = getattr(self, "_in_arity", 1)
+        names = ["data"] if n == 1 else [f"data{i}" for i in range(n)]
+        out = self._to_symbol(*[sym_mod.Variable(nm) for nm in names])
+        if isinstance(out, (list, tuple)):
+            out = sym_mod.Group(out)
+        aux_names = set(out.list_auxiliary_states())
+        arg, aux = {}, {}
+        for name, p in self.collect_params().items():
+            (aux if name in aux_names else arg)[name] = p.data()
+        save_checkpoint(path, epoch, out, arg, aux)
+        return out
 
     def forward(self, *args):
         """Eager path: hybrid_forward with nd + concrete params."""

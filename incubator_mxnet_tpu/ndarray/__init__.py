@@ -13,6 +13,20 @@ from . import linalg   # noqa: E402
 from . import sparse  # noqa: E402
 from .sparse import CSRNDArray, RowSparseNDArray  # noqa: E402
 
+# the imperative cast_storage is storage-aware: dense->dense goes
+# through the registry op (differentiable, tape-recorded); sparse
+# targets/sources go through the sparse converters
+_registry_cast_storage = cast_storage  # populated from the registry
+
+
+def cast_storage(arr, stype="default"):  # noqa: F811
+    from . import sparse as _sparse
+    if stype == "default" and not isinstance(
+            arr, _sparse.BaseSparseNDArray):
+        return _registry_cast_storage(arr, stype="default")
+    return _sparse.cast_storage(arr, stype)
+
 __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
            "concatenate", "waitall", "moveaxis", "save", "load", "random",
-           "linalg", "sparse", "CSRNDArray", "RowSparseNDArray"]
+           "linalg", "sparse", "CSRNDArray", "RowSparseNDArray",
+           "cast_storage"]

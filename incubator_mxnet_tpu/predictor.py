@@ -1,0 +1,118 @@
+"""Deployment predict API.
+
+Python analog of the reference's C predict ABI (ref:
+include/mxnet/c_predict_api.h — MXPredCreate:87, MXPredSetInput:177,
+MXPredForward:191, MXPredGetOutput:160, MXPredReshape) serving a
+`HybridBlock.export` / `Module.save_checkpoint` artifact: symbol JSON
+plus an arg:/aux: params file.  The whole graph compiles to one XLA
+executable on first forward (shape-keyed jit cache), so repeat
+predictions are a single device call.
+"""
+import numpy as np
+
+from . import symbol as sym_mod
+from .context import default_context
+from .ndarray import ndarray as nd_mod
+from .ndarray.ndarray import NDArray
+
+__all__ = ["Predictor", "load_params"]
+
+
+def load_params(param_file):
+    """Split an exported params file into (arg_params, aux_params) —
+    same tag semantics as model.load_checkpoint (unknown tags are
+    ignored, not treated as aux)."""
+    save_dict = nd_mod.load(param_file)
+    arg_params, aux_params = {}, {}
+    for k, v in save_dict.items():
+        tag, name = k.split(":", 1)
+        if tag == "arg":
+            arg_params[name] = v
+        elif tag == "aux":
+            aux_params[name] = v
+    return arg_params, aux_params
+
+
+class Predictor:
+    """Inference-only executor over an exported model.
+
+    Parameters
+    ----------
+    symbol : path to ``*-symbol.json``, a JSON string, or a Symbol
+    param_file : path to the ``*.params`` file (arg:/aux: keys)
+    input_shapes : dict input name -> shape (incl. batch dim) — the
+        reference's MXPredCreate input_keys/input_shape_* arrays
+    ctx : Context (default: the default device)
+    """
+
+    def __init__(self, symbol, param_file, input_shapes, ctx=None,
+                 type_dict=None):
+        if isinstance(symbol, sym_mod.Symbol):
+            self._symbol = symbol
+        elif str(symbol).lstrip().startswith("{"):
+            self._symbol = sym_mod.load_json(symbol)
+        else:
+            self._symbol = sym_mod.load(symbol)
+        self._ctx = ctx or default_context()
+        arg_params, aux_params = load_params(param_file)
+        shapes = dict(input_shapes)
+        shapes.update({k: v.shape for k, v in arg_params.items()})
+        self._exec = self._symbol.simple_bind(
+            self._ctx, grad_req="null", type_dict=type_dict, **shapes)
+        self._exec.copy_params_from(arg_params, aux_params,
+                                    allow_extra_params=True)
+        # positional predict() order = the caller's input_shapes
+        # declaration order (dict order), NOT graph-topological order
+        args = set(self._symbol.list_arguments())
+        self._input_names = [n for n in input_shapes if n in args]
+        self._inputs = {}
+        self._outputs = None
+
+    # ---------------------------------------------------------- C-api
+    def set_input(self, name, value):
+        """MXPredSetInput analog."""
+        if name not in self._input_names:
+            raise KeyError(
+                f"'{name}' is not an input (inputs: "
+                f"{self._input_names})")
+        self._inputs[name] = value if isinstance(value, NDArray) \
+            else nd_mod.array(np.asarray(value))
+
+    def forward(self, **inputs):
+        """MXPredForward analog; inputs may also be passed directly."""
+        for k, v in inputs.items():
+            self.set_input(k, v)
+        missing = [n for n in self._input_names
+                   if n not in self._inputs]
+        if missing:
+            raise ValueError(f"inputs not set: {missing}")
+        self._outputs = self._exec.forward(is_train=False,
+                                           **self._inputs)
+        return self._outputs
+
+    def get_output(self, index=0):
+        """MXPredGetOutput analog."""
+        if self._outputs is None:
+            raise RuntimeError("call forward() first")
+        return self._outputs[index]
+
+    def predict(self, *arrays):
+        """Convenience: positional inputs -> first output's numpy."""
+        if len(arrays) != len(self._input_names):
+            raise ValueError(
+                f"expected {len(self._input_names)} inputs "
+                f"({self._input_names}), got {len(arrays)}")
+        self.forward(**dict(zip(self._input_names, arrays)))
+        return self.get_output(0).asnumpy()
+
+    def reshape(self, input_shapes):
+        """MXPredReshape analog: rebind for new input shapes (dtypes
+        and parameters carry over via Executor.reshape)."""
+        p = Predictor.__new__(Predictor)
+        p._symbol = self._symbol
+        p._ctx = self._ctx
+        p._exec = self._exec.reshape(**input_shapes)
+        p._input_names = list(self._input_names)
+        p._inputs = {}
+        p._outputs = None
+        return p

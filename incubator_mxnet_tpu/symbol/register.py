@@ -9,6 +9,16 @@ from .symbol import Symbol, _invoke
 
 def make_sym_func(opname, op):
     def f(*args, name=None, attr=None, **kwargs):
+        # trailing None positional inputs mean "absent optional
+        # input" (a no-bias conv passes bias=None); an *interior*
+        # None would silently shift later inputs into wrong slots,
+        # so it is rejected
+        while args and args[-1] is None:
+            args = args[:-1]
+        if any(a is None for a in args):
+            raise TypeError(
+                f"sym.{opname}: only trailing optional inputs may be "
+                "None; pass interior optional inputs by keyword")
         for a in args:
             if not isinstance(a, Symbol):
                 raise TypeError(

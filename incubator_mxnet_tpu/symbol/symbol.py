@@ -417,6 +417,11 @@ def _invoke(op, sym_args, params, name=None):
             attrs = {"__is_aux__": "1"} if is_aux else {}
             v = _Node(None, f"{name}_{argname}", attrs=attrs)
             inputs.append((v, 0))
+        # explicitly-passed variables occupying aux slots get tagged
+        # too (the export path passes moving stats as Variables)
+        for i, (n, _) in enumerate(inputs):
+            if i >= len(op.arg_names) and n.is_variable:
+                n.attrs["__is_aux__"] = "1"
     node = _Node(op, name, inputs, params)
     return Symbol([(node, i) for i in range(node.n_outputs())]
                   if node.n_outputs() > 1 else [(node, 0)])

@@ -201,6 +201,15 @@ SPECS = {
                  "broadcast_mod", "broadcast_logical_and",
                  "broadcast_logical_or", "broadcast_logical_xor"]},
     "add_n": dict(inputs=[P(2, 3), P(2, 3)]),
+    "Correlation": dict(inputs=[P(1, 2, 4, 4), P(1, 2, 4, 4)],
+                        params=dict(kernel_size=1, max_displacement=1,
+                                    pad_size=1), rtol=0.08),
+    "IdentityAttachKLSparseReg": dict(inputs=[P(3, 4)], fwd=True),
+    "reshape_like": dict(inputs=[P(2, 3), P(3, 2)]),
+    "_sparse_retain": dict(
+        inputs=[P(4, 2), np.array([1, 3], np.float32)],
+        grad_nodes=["a0"]),
+    "_square_sum": dict(inputs=[P(3, 4)], params=dict(axis=1)),
     "ElementWiseSum": dict(inputs=[P(2, 3), P(2, 3)]),
 }
 
@@ -225,7 +234,7 @@ SKIP = set(
     + ["_slice_assign", "_slice_assign_scalar", "_crop_assign",
        "_crop_assign_scalar", "_scatter_set_nd", "_CrossDeviceCopy",
        "_cross_device_copy", "amp_cast", "cast", "crop",
-       "broadcast_axes"])
+       "broadcast_axes", "_NDArray", "_Native"])
 
 
 def _build_cases():
