@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Static checks (ref role: the reference's Jenkinsfile lint stage,
+which runs pylint/cpplint).  No third-party linters exist in this
+image, so this is a stdlib AST linter covering the defects that
+matter for this codebase: syntax errors, unused imports, wildcard
+imports, duplicate function definitions in a class body, and
+accidental tabs / trailing whitespace.
+
+Exit code 0 = clean.  Usage: python ci/lint.py [paths...]
+"""
+import ast
+import sys
+from pathlib import Path
+
+DEFAULT_PATHS = ["incubator_mxnet_tpu", "tools", "examples", "ci",
+                 "bench.py", "__graft_entry__.py"]
+MAX_LINE = 100
+
+
+def _imported_names(tree):
+    """name -> lineno for every import binding."""
+    out = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[(a.asname or a.name).split(".")[0]] = node.lineno
+        elif isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name != "*":
+                    out[a.asname or a.name] = node.lineno
+    return out
+
+
+def _used_names(tree):
+    # dotted usages (mod.attr) are covered too: the root of an
+    # Attribute chain is itself a Name node in the walk
+    return {node.id for node in ast.walk(tree)
+            if isinstance(node, ast.Name)}
+
+
+def check_file(path):
+    problems = []
+    src = path.read_text()
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
+
+    is_init = path.name == "__init__.py"
+    if not is_init:  # __init__ imports are re-exports by design
+        imported = _imported_names(tree)
+        used = _used_names(tree)
+        # names quoted anywhere in the source (e.g. __all__, doc
+        # references, getattr strings) count as used
+        for name, lineno in sorted(imported.items()):
+            if name in used or name.startswith("_sys"):
+                continue
+            if f'"{name}"' in src or f"'{name}'" in src:
+                continue
+            problems.append(
+                f"{path}:{lineno}: unused import '{name}'")
+
+    for node in ast.walk(tree):
+        if (not is_init and isinstance(node, ast.ImportFrom)
+                and any(a.name == "*" for a in node.names)):
+            # __init__.py wildcard re-exports are the namespace
+            # pattern; anywhere else they hide provenance
+            problems.append(
+                f"{path}:{node.lineno}: wildcard import")
+        if isinstance(node, ast.ClassDef):
+            seen = {}
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    dec = [d for d in item.decorator_list]
+                    # property setters legitimately reuse the name
+                    if any(isinstance(d, ast.Attribute) and
+                           d.attr in ("setter", "getter", "deleter")
+                           for d in dec):
+                        continue
+                    if item.name in seen:
+                        problems.append(
+                            f"{path}:{item.lineno}: duplicate method "
+                            f"'{item.name}' in class {node.name} "
+                            f"(first at line {seen[item.name]})")
+                    seen[item.name] = item.lineno
+
+    for i, line in enumerate(src.splitlines(), 1):
+        if "\t" in line:
+            problems.append(f"{path}:{i}: tab character")
+        if line != line.rstrip():
+            problems.append(f"{path}:{i}: trailing whitespace")
+        if len(line) > MAX_LINE:
+            problems.append(
+                f"{path}:{i}: line too long ({len(line)} > {MAX_LINE})")
+    return problems
+
+
+def main(argv):
+    roots = argv or DEFAULT_PATHS
+    files = []
+    for r in roots:
+        p = Path(r)
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        elif p.suffix == ".py":
+            files.append(p)
+    problems = []
+    for f in files:
+        problems.extend(check_file(f))
+    for p in problems:
+        print(p)
+    print(f"lint: {len(files)} files, {len(problems)} problems")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

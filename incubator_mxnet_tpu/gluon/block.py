@@ -19,10 +19,9 @@ import jax
 
 from .. import autograd, random_state
 from ..autograd import TapeNode
-from ..context import default_context
 from ..ndarray.ndarray import NDArray
 from ..symbol.symbol import Symbol
-from .parameter import (DeferredInitializationError, Parameter,
+from .parameter import (DeferredInitializationError,
                         ParameterDict)
 
 __all__ = ["Block", "HybridBlock", "SymbolBlock"]

@@ -1,12 +1,32 @@
 """DataLoader (ref: python/mxnet/gluon/data/dataloader.py:23-73).
 
-The reference forks worker processes passing batches back through
-POSIX shared memory (CPUSharedStorageManager).  On TPU the bottleneck
-is the host->HBM transfer, not Python-side collation, so workers are
-threads (no pickling, zero-copy into the jnp.asarray staging call) —
-with num_workers=0 meaning synchronous loading, like the reference.
+The reference forks worker processes and passes batches back through
+POSIX shared memory (its CPUSharedStorageManager role): workers run
+``dataset[i]`` + batchify, the parent receives only small descriptors
+and maps the batch bytes out of ``/dev/shm``.  Same design here:
+
+* ``num_workers=0``  — synchronous loading in the caller (reference
+  parity).
+* ``num_workers>0``, ``thread_pool=True`` — thread workers.  No
+  pickling and zero setup cost; right when transforms release the GIL
+  (numpy/cv2) or the bottleneck is host->HBM transfer anyway.
+* ``num_workers>0`` (default) — forked worker *processes*.  Batches
+  come back as ``multiprocessing.shared_memory`` segments (one memcpy
+  from ``/dev/shm`` into the jax staging buffer), so Python-level
+  transforms scale past the GIL exactly like the reference's
+  process workers.
+
+Workers deliberately touch only numpy: forking a process that has
+already initialized an accelerator backend is only safe if the child
+never re-enters that runtime, so batchify inside workers produces
+numpy arrays and the parent promotes them to NDArray.
 """
+import collections
 import concurrent.futures as _futures
+import multiprocessing as _mp
+import os
+import warnings
+from multiprocessing import shared_memory as _shm
 
 import numpy as np
 
@@ -15,6 +35,8 @@ from ...ndarray.ndarray import NDArray
 from .sampler import BatchSampler, RandomSampler, SequentialSampler
 
 __all__ = ["DataLoader", "default_batchify_fn"]
+
+_SHM_PREFIX = "mxtpu_dl_"
 
 
 def default_batchify_fn(data):
@@ -28,12 +50,167 @@ def default_batchify_fn(data):
     return nd_array(data)
 
 
+def _numpy_batchify_fn(data):
+    """default_batchify_fn that stays in numpy — run inside workers."""
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [_numpy_batchify_fn(list(i)) for i in data]
+    if isinstance(data[0], NDArray):
+        _check_fork_safe_ndarray()
+        return np.stack([d.asnumpy() for d in data])
+    return np.asarray(data)
+
+
+def _check_fork_safe_ndarray():
+    """NDArray samples force the forked child back into the device
+    runtime — only safe when the parent's backend is host CPU."""
+    if _worker_accel:
+        raise RuntimeError(
+            "dataset samples are NDArrays but an accelerator backend "
+            "is initialized: a forked DataLoader worker cannot touch "
+            "the device. Return numpy from the dataset (transform on "
+            "host), or use thread_pool=True / num_workers=0.")
+
+
+def _dtype_from_name(name):
+    """dtype.name round-trip that also covers ml_dtypes extension
+    dtypes (bfloat16, fp8...), whose .str is an opaque void code."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _tracker_unregister(name):
+    """Keep the resource_tracker out of segment lifetime accounting.
+
+    Segment ownership crosses the worker/parent boundary (worker
+    creates, parent unlinks), which the per-process tracker cannot
+    model — left registered it both double-unlinks and warns at exit.
+    """
+    try:
+        from multiprocessing import resource_tracker
+        resource_tracker.unregister("/" + name.lstrip("/"),
+                                    "shared_memory")
+    except Exception:
+        pass
+
+
+def _to_shm(obj, prefix):
+    """Recursively move numpy payloads into shared-memory descriptors."""
+    if isinstance(obj, NDArray):          # custom batchify may produce
+        _check_fork_safe_ndarray()
+        inner = _to_shm(obj.asnumpy(), prefix)
+        if inner[0] == "np":
+            return ("nd",) + inner[1:]
+        return ("ndpy", inner[1])         # zero-size: carried inline
+    if isinstance(obj, np.ndarray) and obj.nbytes > 0:
+        arr = np.ascontiguousarray(obj)
+        seg = _shm.SharedMemory(
+            create=True, size=arr.nbytes,
+            name=prefix + os.urandom(8).hex())
+        # the parent unlinks; unregister here so the worker-side
+        # tracker does not also try to (unlink() re-unregisters)
+        _tracker_unregister(seg.name)
+        view = np.frombuffer(seg.buf, dtype=arr.dtype).reshape(arr.shape)
+        view[...] = arr
+        del view                        # release the buffer export
+        name = seg.name
+        seg.close()
+        return ("np", name, arr.shape, arr.dtype.name)
+    if isinstance(obj, (list, tuple)):
+        return ("seq", type(obj) is tuple,
+                [_to_shm(o, prefix) for o in obj])
+    return ("py", obj)
+
+
+def _from_shm(desc):
+    """Parent side: map descriptors back; one memcpy out of /dev/shm.
+
+    Attaching registers the name with the parent's resource tracker
+    and ``unlink()`` unregisters it, so no manual tracker bookkeeping
+    is needed here.
+    """
+    tag = desc[0]
+    if tag in ("np", "nd"):
+        _, name, shape, dtype = desc
+        seg = _shm.SharedMemory(name=name)
+        try:
+            arr = np.frombuffer(
+                seg.buf, dtype=_dtype_from_name(dtype)).reshape(shape)
+            out = arr.copy()        # never alias the shm page: jax's
+            del arr                 # CPU device_put may zero-copy
+        finally:
+            seg.close()
+            seg.unlink()
+        return nd_array(out) if tag == "nd" else out
+    if tag == "ndpy":
+        return nd_array(desc[1])
+    if tag == "seq":
+        _, is_tuple, items = desc
+        items = [_from_shm(i) for i in items]
+        return tuple(items) if is_tuple else items
+    return desc[1]
+
+
+def _promote(obj):
+    """numpy → NDArray, preserving the default-batchify list shape."""
+    if isinstance(obj, np.ndarray):
+        return nd_array(obj)
+    if isinstance(obj, list):
+        return [_promote(o) for o in obj]
+    if isinstance(obj, tuple):
+        return tuple(_promote(o) for o in obj)
+    return obj
+
+
+_worker_dataset = None
+_worker_batchify = None
+_worker_prefix = None
+_worker_accel = False
+
+
+def _worker_init(dataset, batchify_fn, prefix, accel):
+    global _worker_dataset, _worker_batchify, _worker_prefix, \
+        _worker_accel
+    _worker_dataset = dataset
+    _worker_batchify = batchify_fn
+    _worker_prefix = prefix
+    _worker_accel = accel
+
+
+def _worker_fn(indices):
+    batch = _worker_batchify([_worker_dataset[i] for i in indices])
+    return _to_shm(batch, _worker_prefix)
+
+
+def _bounded_window(items, submit, max_inflight):
+    """Yield submitted handles in order with at most ``max_inflight``
+    outstanding: unconsumed batches hold memory (or /dev/shm
+    segments), so workers must not run a whole epoch ahead.  The
+    reference bounds its queue the same way (~2*num_workers)."""
+    inflight = collections.deque()
+    it = iter(items)
+    exhausted = False
+    while inflight or not exhausted:
+        while not exhausted and len(inflight) < max_inflight:
+            try:
+                item = next(it)
+            except StopIteration:
+                exhausted = True
+                break
+            inflight.append(submit(item))
+        if inflight:
+            yield inflight.popleft()
+
+
 class DataLoader:
     """(ref: dataloader.py DataLoader)"""
 
     def __init__(self, dataset, batch_size=None, shuffle=False,
                  sampler=None, last_batch=None, batch_sampler=None,
-                 batchify_fn=None, num_workers=0):
+                 batchify_fn=None, num_workers=0, thread_pool=False):
         self._dataset = dataset
         if batch_sampler is None:
             if batch_size is None:
@@ -47,22 +224,74 @@ class DataLoader:
             batch_sampler = BatchSampler(sampler, batch_size,
                                          last_batch or "keep")
         self._batch_sampler = batch_sampler
-        self._batchify_fn = batchify_fn or default_batchify_fn
-        self._num_workers = num_workers
+        self._batchify_fn = batchify_fn
+        self._num_workers = max(0, num_workers)
+        self._thread_pool = thread_pool
 
     def __iter__(self):
+        batchify = self._batchify_fn or default_batchify_fn
         if self._num_workers == 0:
             for batch in self._batch_sampler:
-                yield self._batchify_fn(
-                    [self._dataset[i] for i in batch])
+                yield batchify([self._dataset[i] for i in batch])
             return
-        with _futures.ThreadPoolExecutor(self._num_workers) as pool:
-            futures = [
-                pool.submit(lambda idxs=batch: self._batchify_fn(
-                    [self._dataset[i] for i in idxs]))
-                for batch in self._batch_sampler]
-            for f in futures:
-                yield f.result()
+        if self._thread_pool:
+            with _futures.ThreadPoolExecutor(self._num_workers) as pool:
+                def submit(idxs):
+                    return pool.submit(
+                        lambda: batchify(
+                            [self._dataset[i] for i in idxs]))
+                for fut in _bounded_window(
+                        self._batch_sampler, submit,
+                        2 * self._num_workers):
+                    yield fut.result()
+            return
+        yield from self._iter_multiprocess()
+
+    def _iter_multiprocess(self):
+        # fork: the dataset is inherited copy-on-write (no pickling);
+        # workers are numpy-only so re-entering an already-initialized
+        # accelerator runtime in the child never happens.
+        # the NDArray-building default batchify must not run in the
+        # forked child (creating jax arrays re-enters the inherited
+        # PJRT client, which can deadlock): substitute the numpy
+        # equivalent and promote to NDArray in the parent.  Custom
+        # batchify fns must themselves stay numpy-only in workers.
+        if (self._batchify_fn is None
+                or self._batchify_fn is default_batchify_fn):
+            worker_batchify, promote = _numpy_batchify_fn, _promote
+        else:
+            worker_batchify, promote = self._batchify_fn, lambda b: b
+        # unique per-iteration segment prefix: in-flight batches whose
+        # descriptors never reach the parent (early abandon, crash)
+        # are reclaimed by the glob below once the workers are dead
+        prefix = "%s%x_%s_" % (_SHM_PREFIX, os.getpid(),
+                               os.urandom(4).hex())
+        import jax
+        accel = jax.default_backend() != "cpu"
+        with warnings.catch_warnings():
+            # the at-fork warnings (jax's RuntimeWarning, CPython
+            # 3.12's multi-threaded-fork DeprecationWarning) do not
+            # apply: the children are numpy-only
+            warnings.filterwarnings("ignore", message=".*fork.*")
+            pool = _mp.get_context("fork").Pool(
+                self._num_workers, initializer=_worker_init,
+                initargs=(self._dataset, worker_batchify, prefix,
+                          accel))
+        try:
+            for res in _bounded_window(
+                    self._batch_sampler,
+                    lambda idxs: pool.apply_async(_worker_fn, (idxs,)),
+                    2 * self._num_workers):
+                yield promote(_from_shm(res.get()))
+        finally:
+            pool.terminate()
+            pool.join()
+            import glob as _glob
+            for path in _glob.glob("/dev/shm/" + prefix + "*"):
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
 
     def __len__(self):
         return len(self._batch_sampler)

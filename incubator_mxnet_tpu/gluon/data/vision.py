@@ -3,14 +3,12 @@
 Download-free: datasets read local idx/npz files (zero-egress
 environments); FashionMNIST/CIFAR expect pre-fetched files.
 """
-import gzip
 import os
-import struct
 
 import numpy as np
 
 from ...ndarray import array as nd_array
-from .dataset import ArrayDataset, Dataset
+from .dataset import Dataset
 
 __all__ = ["MNIST", "FashionMNIST", "CIFAR10", "ImageFolderDataset",
            "transforms"]

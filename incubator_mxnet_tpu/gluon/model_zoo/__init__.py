@@ -1,5 +1,6 @@
 """Gluon model zoo (ref: python/mxnet/gluon/model_zoo/)."""
 from . import vision
+from . import model_store
 from .vision import get_model
 
-__all__ = ["vision", "get_model"]
+__all__ = ["vision", "get_model", "model_store"]

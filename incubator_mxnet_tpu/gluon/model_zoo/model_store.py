@@ -1,0 +1,113 @@
+"""Pretrained-weight store (ref role:
+python/mxnet/gluon/model_zoo/model_store.py — get_model_file/purge).
+
+The reference resolves ``pretrained=True`` by downloading
+``<name>-<sha1[:8]>.params`` from its S3 bucket into
+``~/.mxnet/models`` and sha1-checking it.  This environment has zero
+egress, so the store is purely local: weights are *installed* into
+the cache (``import_model_file`` — e.g. converted from another
+framework offline, or trained here and published), and
+``get_model_file`` resolves from it.  The cache root is
+``$MXTPU_HOME/models`` (default ``~/.mxtpu/models``), overridable per
+call exactly like the reference's ``root=`` argument.
+"""
+import hashlib
+import os
+import shutil
+
+__all__ = ["get_model_file", "import_model_file", "purge",
+           "list_models"]
+
+
+def _default_root():
+    home = os.environ.get("MXTPU_HOME",
+                          os.path.join(os.path.expanduser("~"),
+                                       ".mxtpu"))
+    return os.path.join(home, "models")
+
+
+def _file_name(name, sha1=None):
+    return f"{name}-{sha1[:8]}.params" if sha1 else f"{name}.params"
+
+
+def get_model_file(name, root=None):
+    """Path of the cached params file for ``name``.
+
+    Accepts both the plain ``<name>.params`` layout and the
+    reference's sha1-tagged ``<name>-xxxxxxxx.params`` (in which case
+    the newest tagged file wins and its digest is verified).
+    Raises FileNotFoundError with install instructions if absent —
+    the download the reference would attempt cannot happen here.
+    """
+    root = os.path.expanduser(root or _default_root())
+    plain = os.path.join(root, _file_name(name))
+    if os.path.exists(plain):
+        return plain
+    if os.path.isdir(root):
+        tagged = sorted(
+            (f for f in os.listdir(root)
+             if f.startswith(name + "-") and f.endswith(".params")
+             and len(f) == len(name) + 1 + 8 + len(".params")),
+            key=lambda f: os.path.getmtime(os.path.join(root, f)))
+        if tagged:
+            path = os.path.join(root, tagged[-1])
+            tag = tagged[-1][len(name) + 1:-len(".params")]
+            if not _sha1(path).startswith(tag):
+                raise OSError(
+                    f"checksum mismatch for {path}; re-install it "
+                    f"(import_model_file) or delete it (purge)")
+            return path
+    raise FileNotFoundError(
+        f"no pretrained weights for '{name}' in {root} (zero-egress "
+        f"environment: the reference would download them; here "
+        f"install a params file with "
+        f"model_store.import_model_file(src, '{name}') or save one "
+        f"to {plain})")
+
+
+def import_model_file(src, name, root=None):
+    """Install a params file into the cache under ``name`` with the
+    reference's sha1-tagged file name; returns the cached path."""
+    root = os.path.expanduser(root or _default_root())
+    os.makedirs(root, exist_ok=True)
+    dst = os.path.join(root, _file_name(name, _sha1(src)))
+    shutil.copyfile(src, dst)
+    return dst
+
+
+def list_models(root=None):
+    """Names with weights available in the cache."""
+    root = os.path.expanduser(root or _default_root())
+    if not os.path.isdir(root):
+        return []
+    names = set()
+    for f in os.listdir(root):
+        if f.endswith(".params"):
+            stem = f[:-len(".params")]
+            base, dash, tag = stem.rpartition("-")
+            names.add(base if dash and len(tag) == 8 else stem)
+    return sorted(names)
+
+
+def purge(root=None):
+    """Delete every cached params file (ref: model_store.purge)."""
+    root = os.path.expanduser(root or _default_root())
+    if os.path.isdir(root):
+        for f in os.listdir(root):
+            if f.endswith(".params"):
+                os.remove(os.path.join(root, f))
+
+
+def load_pretrained(net, name, ctx=None, root=None):
+    """Resolve ``name`` in the store and load it into ``net`` — the
+    factory-side half of the reference's ``pretrained=True`` flow."""
+    net.load_params(get_model_file(name, root=root), ctx=ctx)
+    return net
+
+
+def _sha1(path):
+    h = hashlib.sha1()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()

@@ -37,7 +37,9 @@ class AlexNet(HybridBlock):
         return self.output(self.features(x))
 
 
-def alexnet(pretrained=False, ctx=None, **kwargs):
+def alexnet(pretrained=False, ctx=None, root=None, **kwargs):
+    net = AlexNet(**kwargs)
     if pretrained:
-        raise ValueError("pretrained weights unavailable (zero egress)")
-    return AlexNet(**kwargs)
+        from ..model_store import load_pretrained
+        load_pretrained(net, "alexnet", ctx=ctx, root=root)
+    return net

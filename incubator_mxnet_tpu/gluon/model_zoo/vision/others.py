@@ -10,9 +10,11 @@ __all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1", "DenseNet",
            "mobilenet0_25", "Inception3", "inception_v3"]
 
 
-def _no_pretrained(pretrained):
+def _pretrained(net, pretrained, name, ctx=None, root=None):
     if pretrained:
-        raise ValueError("pretrained weights unavailable (zero egress)")
+        from ..model_store import load_pretrained
+        load_pretrained(net, name, ctx=ctx, root=root)
+    return net
 
 
 # ---------------------------------------------------------------- squeeze
@@ -75,14 +77,14 @@ class SqueezeNet(HybridBlock):
         return self.output(self.features(x))
 
 
-def squeezenet1_0(pretrained=False, **kw):
-    _no_pretrained(pretrained)
-    return SqueezeNet("1.0", **kw)
+def squeezenet1_0(pretrained=False, ctx=None, root=None, **kw):
+    return _pretrained(SqueezeNet("1.0", **kw), pretrained,
+                       "squeezenet1.0", ctx, root)
 
 
-def squeezenet1_1(pretrained=False, **kw):
-    _no_pretrained(pretrained)
-    return SqueezeNet("1.1", **kw)
+def squeezenet1_1(pretrained=False, ctx=None, root=None, **kw):
+    return _pretrained(SqueezeNet("1.1", **kw), pretrained,
+                       "squeezenet1.1", ctx, root)
 
 
 # ---------------------------------------------------------------- dense
@@ -152,10 +154,10 @@ densenet_spec = {121: (64, 32, [6, 12, 24, 16]),
 
 
 def _make_dense(n):
-    def f(pretrained=False, **kw):
-        _no_pretrained(pretrained)
+    def f(pretrained=False, ctx=None, root=None, **kw):
         a, b, c = densenet_spec[n]
-        return DenseNet(a, b, c, **kw)
+        return _pretrained(DenseNet(a, b, c, **kw), pretrained,
+                           f"densenet{n}", ctx, root)
     f.__name__ = f"densenet{n}"
     return f
 
@@ -206,9 +208,9 @@ class MobileNet(HybridBlock):
 
 
 def _make_mobile(mult, suffix):
-    def f(pretrained=False, **kw):
-        _no_pretrained(pretrained)
-        return MobileNet(mult, **kw)
+    def f(pretrained=False, ctx=None, root=None, **kw):
+        return _pretrained(MobileNet(mult, **kw), pretrained,
+                           f"mobilenet{suffix}", ctx, root)
     f.__name__ = f"mobilenet{suffix}"
     return f
 
@@ -310,6 +312,6 @@ class Inception3(HybridBlock):
         return self.output(self.features(x))
 
 
-def inception_v3(pretrained=False, **kw):
-    _no_pretrained(pretrained)
-    return Inception3(**kw)
+def inception_v3(pretrained=False, ctx=None, root=None, **kw):
+    return _pretrained(Inception3(**kw), pretrained, "inception_v3",
+                       ctx, root)

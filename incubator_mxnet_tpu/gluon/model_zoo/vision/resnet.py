@@ -253,15 +253,15 @@ resnet_block_versions = [
 
 
 def get_resnet(version, num_layers, pretrained=False, ctx=None,
-               **kwargs):
+               root=None, **kwargs):
     block_type, layers, channels = resnet_spec[num_layers]
     resnet_class = resnet_net_versions[version - 1]
     block_class = resnet_block_versions[version - 1][block_type]
     net = resnet_class(block_class, layers, channels, **kwargs)
     if pretrained:
-        raise ValueError("pretrained weights unavailable in "
-                         "zero-egress environment; load params "
-                         "explicitly via load_params()")
+        from ..model_store import load_pretrained
+        load_pretrained(net, f"resnet{num_layers}_v{version}",
+                        ctx=ctx, root=root)
     return net
 
 

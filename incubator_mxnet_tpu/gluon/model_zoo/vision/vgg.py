@@ -42,11 +42,16 @@ class VGG(HybridBlock):
         return self.output(self.features(x))
 
 
-def get_vgg(num_layers, pretrained=False, ctx=None, **kwargs):
-    if pretrained:
-        raise ValueError("pretrained weights unavailable (zero egress)")
+def get_vgg(num_layers, pretrained=False, ctx=None, root=None,
+            **kwargs):
     layers, filters = vgg_spec[num_layers]
-    return VGG(layers, filters, **kwargs)
+    net = VGG(layers, filters, **kwargs)
+    if pretrained:
+        from ..model_store import load_pretrained
+        bn = "_bn" if kwargs.get("batch_norm") else ""
+        load_pretrained(net, f"vgg{num_layers}{bn}", ctx=ctx,
+                        root=root)
+    return net
 
 
 def _make(n, bn):

@@ -13,7 +13,6 @@ Role analogs (ref file:line):
   validates args and defers to the image package)
 """
 import gzip
-import os
 import queue
 import struct
 import threading

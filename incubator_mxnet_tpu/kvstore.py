@@ -16,7 +16,6 @@ TPU-native design (SURVEY.md §2.6/§5): there is no parameter server —
 - 'dist_async' has no ICI analog (ref async PS apply-on-arrival);
   create() raises with guidance, as decided in SURVEY.md §7.
 """
-import pickle
 
 from . import optimizer as opt_mod
 from .ndarray.ndarray import NDArray

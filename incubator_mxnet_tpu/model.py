@@ -46,6 +46,22 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
     nd.save(f"{prefix}-{epoch:04d}.params", save_dict)
 
 
+def split_tagged_params(save_dict):
+    """Split a saved params dict on its ``arg:``/``aux:`` tags ->
+    (arg_params, aux_params).  Untagged keys (a raw ``nd.save`` of a
+    param dict) count as args; unknown tags are ignored."""
+    arg_params, aux_params = {}, {}
+    for k, v in save_dict.items():
+        tp, _, name = k.partition(":")
+        if not name:
+            arg_params[k] = v
+        elif tp == "arg":
+            arg_params[name] = v
+        elif tp == "aux":
+            aux_params[name] = v
+    return arg_params, aux_params
+
+
 def load_checkpoint(prefix, epoch):
     """(ref: model.py load_checkpoint) -> (symbol, arg_params, aux_params)."""
     import os
@@ -53,11 +69,5 @@ def load_checkpoint(prefix, epoch):
     if os.path.exists(f"{prefix}-symbol.json"):
         symbol = sym.load(f"{prefix}-symbol.json")
     save_dict = nd.load(f"{prefix}-{epoch:04d}.params")
-    arg_params, aux_params = {}, {}
-    for k, v in save_dict.items():
-        tp, name = k.split(":", 1)
-        if tp == "arg":
-            arg_params[name] = v
-        elif tp == "aux":
-            aux_params[name] = v
+    arg_params, aux_params = split_tagged_params(save_dict)
     return symbol, arg_params, aux_params

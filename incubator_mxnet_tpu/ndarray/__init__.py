@@ -3,7 +3,8 @@ import sys as _sys
 
 from .ndarray import (NDArray, array, zeros, ones, full, empty, arange,
                       concatenate, imperative_invoke, waitall, moveaxis,
-                      save, load)
+                      save, load, to_dlpack_for_read, to_dlpack_for_write,
+                      from_dlpack)
 from . import register as _register
 
 _internal = _register.populate(_sys.modules[__name__])
@@ -29,4 +30,5 @@ def cast_storage(arr, stype="default"):  # noqa: F811
 __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
            "concatenate", "waitall", "moveaxis", "save", "load", "random",
            "linalg", "sparse", "CSRNDArray", "RowSparseNDArray",
-           "cast_storage"]
+           "cast_storage", "to_dlpack_for_read", "to_dlpack_for_write",
+           "from_dlpack"]

@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import autograd, engine, random_state
-from ..base import np_dtype, TShape
+from ..base import np_dtype
 from ..context import Context, default_context
 from ..ops.registry import get_op
 
@@ -480,6 +480,15 @@ class NDArray:
         a = self.asnumpy()
         return a.astype(dtype) if dtype else a
 
+    # dlpack protocol (ref role: the dmlc/dlpack submodule in
+    # .gitmodules — zero-copy tensor interchange with torch etc.;
+    # here it delegates to the backing jax.Array's own exporter)
+    def __dlpack__(self, *args, **kwargs):
+        return self._data.__dlpack__(*args, **kwargs)
+
+    def __dlpack_device__(self):
+        return self._data.__dlpack_device__()
+
 
 # ---------------------------------------------------------------------------
 # the imperative invoke path (role of Imperative::Invoke)
@@ -642,8 +651,83 @@ def waitall():
 
 
 # ---------------------------------------------------------------------------
+# dlpack interchange (ref role: dmlc/dlpack submodule; API names match
+# mxnet's MXNDArrayToDLPack/FromDLPack surface)
+# ---------------------------------------------------------------------------
+
+
+def _export_capsule(data):
+    # raw capsules carry no device info, and from_dlpack reimports
+    # them as kDLCPU — so capsule export is host-only by contract.
+    # Cross-device consumers use the protocol object (the NDArray
+    # itself) instead, which carries __dlpack_device__.
+    if data._data.__dlpack_device__()[0] != 1:  # kDLCPU
+        raise ValueError(
+            "to_dlpack_for_* exports host (CPU) buffers only; pass "
+            "the NDArray itself to the consumer's from_dlpack (the "
+            "__dlpack__ protocol carries the device), or copy to "
+            "cpu() first")
+    return data._data.__dlpack__()
+
+
+def to_dlpack_for_read(data):
+    """Export as a DLPack capsule (read view of the host buffer)."""
+    return _export_capsule(data)
+
+
+def to_dlpack_for_write(data):
+    """Export as a DLPack capsule.  jax.Arrays are immutable, so the
+    'write' flavor is the same exporter; consumers that mutate the
+    buffer see framework-undefined behavior exactly as with the
+    reference's write capsule after a pending read."""
+    return _export_capsule(data)
+
+
+class _DLPackCapsule:
+    """Adapter: jax's from_dlpack consumes protocol objects only, so
+    a raw capsule (what to_dlpack_for_* hands out, like the
+    reference's MXNDArrayToDLPack) is wrapped with the protocol.
+    Raw capsules carry no device info; they are host-interchange
+    (kDLCPU) by construction here."""
+
+    def __init__(self, capsule):
+        self._capsule = capsule
+
+    def __dlpack__(self, **kwargs):
+        return self._capsule
+
+    def __dlpack_device__(self):
+        return (1, 0)  # kDLCPU
+
+
+def from_dlpack(ext):
+    """NDArray from any DLPack-exporting tensor (torch, numpy, ...)
+    or capsule, zero-copy when device/layout allow."""
+    if type(ext).__name__ == "PyCapsule":
+        ext = _DLPackCapsule(ext)
+    return NDArray(jnp.from_dlpack(ext))
+
+
+# ---------------------------------------------------------------------------
 # serialization (ref: MXNDArraySave/Load, src/ndarray/ndarray.cc save/load)
 # ---------------------------------------------------------------------------
+
+
+def _encode_ext_dtype(k, arr):
+    """npz cannot represent ml_dtypes extension dtypes (bfloat16,
+    fp8...): store the raw bits as uintN and tag the key."""
+    if arr.dtype.kind == "V":
+        return (f"__xdt_{arr.dtype.name}__{k}",
+                arr.view(np.dtype(f"u{arr.dtype.itemsize}")))
+    return k, arr
+
+
+def _decode_ext_dtype(k, arr):
+    if k.startswith("__xdt_"):
+        import ml_dtypes
+        name, _, orig = k[len("__xdt_"):].partition("__")
+        return orig, arr.view(np.dtype(getattr(ml_dtypes, name)))
+    return k, arr
 
 
 def save(fname, data):
@@ -655,13 +739,14 @@ def save(fname, data):
         payload = {k: v.asnumpy() for k, v in data.items()}
     else:
         payload = {f"__pos_{i}": v.asnumpy() for i, v in enumerate(data)}
+    payload = dict(_encode_ext_dtype(k, v) for k, v in payload.items())
     with open(fname, "wb") as f:
         np.savez(f, **payload)
 
 
 def load(fname):
     with np.load(fname, allow_pickle=False) as z:
-        keys = list(z.keys())
-        if keys and all(k.startswith("__pos_") for k in keys):
-            return [array(z[f"__pos_{i}"]) for i in range(len(keys))]
-        return {k: array(z[k]) for k in keys}
+        items = dict(_decode_ext_dtype(k, z[k]) for k in z.keys())
+    if items and all(k.startswith("__pos_") for k in items):
+        return [array(items[f"__pos_{i}"]) for i in range(len(items))]
+    return {k: array(v) for k, v in items.items()}

@@ -15,6 +15,8 @@ def make_nd_func(opname, op):
     from .ndarray import imperative_invoke
 
     def f(*args, out=None, name=None, **kwargs):
+        from .ndarray import NDArray
+
         pos = list(args)
         # accept tensor inputs by keyword (data=..., lhs=..., ...)
         for an in op.arg_names[len(pos):]:
@@ -22,6 +24,17 @@ def make_nd_func(opname, op):
                 pos.append(kwargs.pop(an))
             else:
                 break
+        # eager ops cannot auto-create missing inputs, so an array
+        # kwarg left behind a gap must fail loudly, not become a param
+        leftover = [k for k, v in kwargs.items()
+                    if isinstance(v, NDArray)]
+        if leftover:
+            missing = [n for n in op.arg_names[len(pos):]
+                       if n not in kwargs]
+            raise TypeError(
+                f"nd.{opname}: array inputs {leftover} given by "
+                f"keyword, but earlier inputs {missing} are missing "
+                f"— eager ops need every input")
         return imperative_invoke(op, pos, kwargs, out)
 
     f.__name__ = opname

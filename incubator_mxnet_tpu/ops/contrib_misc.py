@@ -134,28 +134,20 @@ def correlation(data1, data2, kernel_size=1, max_displacement=1,
     ys = border + s1 * jnp.arange(out_h)
     xs = border + s1 * jnp.arange(out_w)
 
+    combine = ((lambda a, b: a * b) if is_multiply
+               else (lambda a, b: jnp.abs(a - b)))
     outs = []
     for dy in range(-d2 * s2, d2 * s2 + 1, s2):
         for dx in range(-d2 * s2, d2 * s2 + 1, s2):
-            if is_multiply:
-                # correlate channel-wise then mean over c*K^2
-                acc = 0
-                for ky in range(-kr, K - kr):
-                    for kx in range(-kr, K - kr):
-                        rows = ys + ky
-                        cols = xs + kx
-                        a = p1[:, :, rows][:, :, :, cols]
-                        bb = p2[:, :, rows + dy][:, :, :, cols + dx]
-                        acc = acc + (a * bb).sum(axis=1)
-            else:
-                acc = 0
-                for ky in range(-kr, K - kr):
-                    for kx in range(-kr, K - kr):
-                        rows = ys + ky
-                        cols = xs + kx
-                        a = p1[:, :, rows][:, :, :, cols]
-                        bb = p2[:, :, rows + dy][:, :, :, cols + dx]
-                        acc = acc + jnp.abs(a - bb).sum(axis=1)
+            # correlate channel-wise then mean over c*K^2
+            acc = 0
+            for ky in range(-kr, K - kr):
+                for kx in range(-kr, K - kr):
+                    rows = ys + ky
+                    cols = xs + kx
+                    a = p1[:, :, rows][:, :, :, cols]
+                    bb = p2[:, :, rows + dy][:, :, :, cols + dx]
+                    acc = acc + combine(a, bb).sum(axis=1)
             outs.append(acc / (c * K * K))
     return jnp.stack(outs, axis=1).astype(data1.dtype)
 

@@ -6,7 +6,7 @@ CUB kernels; XLA emits them natively).
 """
 import jax.numpy as jnp
 
-from .registry import defop, alias
+from .registry import defop
 
 
 @defop("Embedding", aliases=["_contrib_SparseEmbedding"])

@@ -4,7 +4,7 @@ bridge ops _linalg_*).  XLA provides these natively on TPU.
 import jax.numpy as jnp
 from jax import scipy as jsp
 
-from .registry import defop, alias
+from .registry import defop
 
 
 @defop("_linalg_gemm", aliases=["linalg_gemm"])

@@ -5,10 +5,9 @@ swapaxis.cc, pad.cc, crop.cc, control_flow_op.cc, init_op.cc cast).
 On TPU, `dot`/`batch_dot` are the MXU ops; everything else is layout
 work that XLA folds into surrounding fusions.
 """
-import jax
 import jax.numpy as jnp
 
-from .registry import defop, alias
+from .registry import defop
 
 
 # ------------------------------------------------------------------ reshape

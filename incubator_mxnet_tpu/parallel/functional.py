@@ -12,7 +12,6 @@ plus the current parameter values split into trainable params and
 non-trainable states (BatchNorm moving stats — the reference's
 auxiliary states, ref: include/mxnet/operator.h aux_states).
 """
-import jax
 
 from .. import autograd, random_state
 from ..ndarray.ndarray import NDArray

@@ -20,17 +20,10 @@ __all__ = ["Predictor", "load_params"]
 
 def load_params(param_file):
     """Split an exported params file into (arg_params, aux_params) —
-    same tag semantics as model.load_checkpoint (unknown tags are
-    ignored, not treated as aux)."""
-    save_dict = nd_mod.load(param_file)
-    arg_params, aux_params = {}, {}
-    for k, v in save_dict.items():
-        tag, name = k.split(":", 1)
-        if tag == "arg":
-            arg_params[name] = v
-        elif tag == "aux":
-            aux_params[name] = v
-    return arg_params, aux_params
+    same tag semantics as model.load_checkpoint (untagged keys count
+    as args, unknown tags are ignored)."""
+    from .model import split_tagged_params
+    return split_tagged_params(nd_mod.load(param_file))
 
 
 class Predictor:
@@ -57,8 +50,13 @@ class Predictor:
         arg_params, aux_params = load_params(param_file)
         shapes = dict(input_shapes)
         shapes.update({k: v.shape for k, v in arg_params.items()})
+        # bind at the dtypes the model was trained/exported at (e.g.
+        # bf16), not a silent float32 default; explicit type_dict wins
+        td = {k: v.dtype
+              for p in (arg_params, aux_params) for k, v in p.items()}
+        td.update(type_dict or {})
         self._exec = self._symbol.simple_bind(
-            self._ctx, grad_req="null", type_dict=type_dict, **shapes)
+            self._ctx, grad_req="null", type_dict=td, **shapes)
         self._exec.copy_params_from(arg_params, aux_params,
                                     allow_extra_params=True)
         # positional predict() order = the caller's input_shapes

@@ -17,7 +17,13 @@ from collections import namedtuple
 import numpy as np
 
 __all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader",
-           "pack", "unpack", "pack_img", "unpack_img"]
+           "pack", "unpack", "pack_img", "unpack_img",
+           "backend_name"]
+
+
+def backend_name():
+    """'native' when the C library is loaded, else 'python'."""
+    return "native" if _native_lib() is not None else "python"
 
 _MAGIC = 0xced7230a
 _LIB = None

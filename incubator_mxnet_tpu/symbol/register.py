@@ -28,21 +28,26 @@ def make_sym_func(opname, op):
                     f"(e.g. `x + 3`, sym._internal._maximum_scalar)")
         sym_args = list(args)
         if not op.variadic:
-            for an in op.arg_names[len(sym_args):]:
+            # fill remaining input slots from keywords; a missing
+            # interior slot becomes a None gap that _invoke fills
+            # with an auto-created variable (so e.g.
+            # FullyConnected(x, bias=b) keeps b in the bias slot)
+            needed = list(op.arg_names) + list(op.aux_names)
+            for an in needed[len(sym_args):]:
                 if an in kwargs and isinstance(kwargs[an], Symbol):
                     sym_args.append(kwargs.pop(an))
                 else:
-                    break
-            # aux inputs may also be passed by keyword
-            if len(sym_args) >= len(op.arg_names):
-                for an in op.aux_names[
-                        len(sym_args) - len(op.arg_names):]:
-                    if an in kwargs and isinstance(kwargs[an], Symbol):
-                        sym_args.append(kwargs.pop(an))
-                    else:
-                        break
-        params = {k: v for k, v in kwargs.items()
-                  if not isinstance(v, Symbol) and v is not None}
+                    sym_args.append(None)
+            while sym_args and sym_args[-1] is None:
+                sym_args.pop()
+        leftover = [k for k, v in kwargs.items()
+                    if isinstance(v, Symbol)]
+        if leftover:
+            raise TypeError(
+                f"sym.{opname}: {leftover} are not input slots of "
+                f"this op (inputs: {list(op.arg_names)} + aux "
+                f"{list(op.aux_names)})")
+        params = {k: v for k, v in kwargs.items() if v is not None}
         out = _invoke(op, sym_args, params, name)
         if attr:
             out._set_attr(**attr)

@@ -402,26 +402,33 @@ def _to_symbol_entry(s):
 def _invoke(op, sym_args, params, name=None):
     """Create a graph node from symbolic inputs; auto-create variables
     for missing parameter/aux inputs (matches the reference's
-    auto-created fc1_weight etc.)."""
+    auto-created fc1_weight etc.).  ``None`` entries in sym_args are
+    interior gaps (input given by keyword with an earlier slot
+    omitted) and are auto-created in place."""
     name = name or NameManager.next_name(op.name)
-    inputs = [s._entry() for s in sym_args]
+    inputs = [None if s is None else s._entry() for s in sym_args]
     if not op.variadic:
         needed = list(op.arg_names) + list(op.aux_names)
-        for i in range(len(inputs), len(needed)):
-            argname = needed[i]
-            no_bias = params.get(
-                "no_bias", op.param_defaults.get("no_bias", False))
-            if argname == "bias" and no_bias:
-                continue
+        no_bias = params.get(
+            "no_bias", op.param_defaults.get("no_bias", False))
+        filled = []
+        for i, argname in enumerate(needed):
             is_aux = i >= len(op.arg_names)
-            attrs = {"__is_aux__": "1"} if is_aux else {}
-            v = _Node(None, f"{name}_{argname}", attrs=attrs)
-            inputs.append((v, 0))
-        # explicitly-passed variables occupying aux slots get tagged
-        # too (the export path passes moving stats as Variables)
-        for i, (n, _) in enumerate(inputs):
-            if i >= len(op.arg_names) and n.is_variable:
-                n.attrs["__is_aux__"] = "1"
+            given = inputs[i] if i < len(inputs) else None
+            if given is None:
+                if argname == "bias" and no_bias:
+                    continue
+                attrs = {"__is_aux__": "1"} if is_aux else {}
+                filled.append(
+                    (_Node(None, f"{name}_{argname}", attrs=attrs), 0))
+            else:
+                # explicitly-passed variables occupying aux slots get
+                # tagged too (export passes moving stats as Variables)
+                if is_aux and given[0].is_variable:
+                    given[0].attrs["__is_aux__"] = "1"
+                filled.append(given)
+        filled.extend(inputs[len(needed):])   # over-provided: keep
+        inputs = filled
     node = _Node(op, name, inputs, params)
     return Symbol([(node, i) for i in range(node.n_outputs())]
                   if node.n_outputs() > 1 else [(node, 0)])

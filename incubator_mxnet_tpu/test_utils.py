@@ -13,10 +13,9 @@ float dtypes.
 """
 import numpy as np
 
-from .context import cpu, tpu, default_context, Context
+from .context import default_context
 from .executor import Executor
 from .ndarray.ndarray import NDArray, array as _nd_array
-from .symbol.symbol import Symbol
 
 __all__ = ["assert_almost_equal", "same", "rand_shape_2d",
            "rand_shape_3d", "rand_ndarray", "random_arrays",

@@ -5,7 +5,6 @@ print_summary walks the Symbol graph exactly like the reference
 (topological order, per-layer shape + parameter count columns);
 plot_network emits graphviz when the library is present.
 """
-import json
 
 __all__ = ["print_summary", "plot_network"]
 

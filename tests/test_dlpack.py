@@ -1,0 +1,40 @@
+"""DLPack interchange (ref role: dmlc/dlpack submodule in
+.gitmodules — zero-copy tensor exchange with other frameworks)."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+
+
+def test_dlpack_protocol_to_torch():
+    import torch
+    a = nd.array(np.arange(6, dtype="float32").reshape(2, 3))
+    t = torch.from_dlpack(a)          # consumes __dlpack__
+    assert t.shape == (2, 3) and t.dtype == torch.float32
+    np.testing.assert_allclose(t.numpy(), a.asnumpy())
+
+
+def test_from_dlpack_torch_and_numpy():
+    import torch
+    t = torch.arange(8).float().reshape(2, 4)
+    a = nd.from_dlpack(t)
+    assert isinstance(a, nd.NDArray) and a.shape == (2, 4)
+    np.testing.assert_allclose(a.asnumpy(), t.numpy())
+    # interchange result is usable as a normal operand
+    np.testing.assert_allclose((a + 1).asnumpy(), t.numpy() + 1)
+
+
+def test_capsule_roundtrip():
+    a = nd.array(np.eye(3, dtype="float32"))
+    cap = nd.to_dlpack_for_read(a)
+    b = nd.from_dlpack(cap)
+    np.testing.assert_allclose(b.asnumpy(), np.eye(3))
+    cap2 = nd.to_dlpack_for_write(a)
+    c = nd.from_dlpack(cap2)
+    np.testing.assert_allclose(c.asnumpy(), np.eye(3))
+
+
+def test_dlpack_device():
+    a = nd.array(np.zeros(2, "float32"))
+    dev_type, dev_id = a.__dlpack_device__()
+    assert isinstance(dev_type, int) and isinstance(dev_id, int)

@@ -1,0 +1,64 @@
+"""Pretrained model store (ref:
+python/mxnet/gluon/model_zoo/model_store.py — but local-cache-only
+under zero egress: weights are installed, then pretrained=True
+resolves them)."""
+import os
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon
+from incubator_mxnet_tpu.gluon.model_zoo import model_store, vision
+
+
+def test_get_model_file_missing_is_informative(tmp_path):
+    with pytest.raises(FileNotFoundError) as e:
+        model_store.get_model_file("resnet18_v1", root=str(tmp_path))
+    assert "import_model_file" in str(e.value)
+
+
+def test_import_resolve_and_verify(tmp_path):
+    src = tmp_path / "src.params"
+    net = vision.squeezenet1_0()
+    net.initialize(mx.initializer.Xavier())
+    net(mx.nd.array(np.zeros((1, 3, 64, 64), "float32")))
+    net.save_params(str(src))
+    cached = model_store.import_model_file(str(src), "squeezenet1.0",
+                                           root=str(tmp_path / "c"))
+    assert os.path.basename(cached).startswith("squeezenet1.0-")
+    got = model_store.get_model_file("squeezenet1.0",
+                                     root=str(tmp_path / "c"))
+    assert got == cached
+    assert model_store.list_models(root=str(tmp_path / "c")) == \
+        ["squeezenet1.0"]
+    # corrupting the file must fail the sha1 tag check
+    with open(cached, "r+b") as f:
+        f.write(b"\0\0\0\0")
+    with pytest.raises(OSError):
+        model_store.get_model_file("squeezenet1.0",
+                                   root=str(tmp_path / "c"))
+    model_store.purge(root=str(tmp_path / "c"))
+    assert model_store.list_models(root=str(tmp_path / "c")) == []
+
+
+def test_pretrained_true_loads_weights(tmp_path):
+    mx.random.seed(7)
+    net = vision.squeezenet1_0()
+    net.initialize(mx.initializer.Xavier())
+    x = mx.nd.array(np.random.RandomState(0).rand(1, 3, 64, 64)
+                    .astype("float32"))
+    ref_out = net(x).asnumpy()
+    src = tmp_path / "w.params"
+    net.save_params(str(src))
+    model_store.import_model_file(str(src), "squeezenet1.0",
+                                  root=str(tmp_path / "cache"))
+    net2 = vision.squeezenet1_0(pretrained=True,
+                                root=str(tmp_path / "cache"))
+    np.testing.assert_allclose(net2(x).asnumpy(), ref_out,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pretrained_missing_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        vision.resnet18_v1(pretrained=True, root=str(tmp_path))

@@ -128,6 +128,20 @@ def test_save_load(tmp_path):
     assert isinstance(loaded, list) and len(loaded) == 2
 
 
+def test_save_load_extension_dtypes(tmp_path):
+    # ml_dtypes extension dtypes (bfloat16) must round-trip: npz has
+    # no native descr for them, so save() encodes raw bits + dtype tag
+    fname = str(tmp_path / "bf16.bin")
+    w = nd.array(np.arange(6, dtype="float32").reshape(2, 3))
+    d = {"w16": w.astype("bfloat16"), "w32": w}
+    nd.save(fname, d)
+    loaded = nd.load(fname)
+    assert str(loaded["w16"].dtype) == "bfloat16"
+    assert str(loaded["w32"].dtype) == "float32"
+    np.testing.assert_allclose(
+        loaded["w16"].astype("float32").asnumpy(), w.asnumpy())
+
+
 def test_wait_and_iter():
     a = nd.ones((4, 2))
     a.wait_to_read()

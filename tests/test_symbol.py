@@ -130,3 +130,47 @@ def test_variable_attrs():
     v = sym.Variable("w", shape=(3, 4), lr_mult=2.0)
     assert v.attr("__shape__") == "(3, 4)"
     assert v.attr("__lr_mult__") == "2.0"
+
+
+def test_keyword_input_after_gap():
+    # bias given by keyword with weight omitted must land in the bias
+    # slot (weight auto-created), not be silently dropped
+    data = sym.Variable("data")
+    my_bias = sym.Variable("my_bias")
+    fc = sym.FullyConnected(data, bias=my_bias, num_hidden=4,
+                            name="fc")
+    args = fc.list_arguments()
+    assert "my_bias" in args, args
+    assert "fc_bias" not in args, args
+    assert "fc_weight" in args, args
+    # executor actually uses the provided bias
+    import incubator_mxnet_tpu as mx2
+    ex = fc.simple_bind(mx2.cpu(), data=(2, 3))
+    ex.arg_dict["data"][:] = 0
+    ex.arg_dict["my_bias"][:] = mx2.nd.array(
+        np.arange(4, dtype="float32"))
+    out = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(out, np.tile(np.arange(4.0), (2, 1)))
+
+
+def test_unknown_symbol_kwarg_raises():
+    data = sym.Variable("data")
+    stray = sym.Variable("stray")
+    try:
+        sym.FullyConnected(data, bogus_input=stray, num_hidden=4)
+    except TypeError as e:
+        assert "bogus_input" in str(e)
+    else:
+        raise AssertionError("unknown Symbol kwarg accepted")
+
+
+def test_nd_missing_input_raises():
+    import incubator_mxnet_tpu as mx2
+    x = mx2.nd.array(np.zeros((2, 3), "float32"))
+    b = mx2.nd.array(np.zeros(4, "float32"))
+    try:
+        mx2.nd.FullyConnected(x, bias=b, num_hidden=4)
+    except TypeError as e:
+        assert "bias" in str(e)
+    else:
+        raise AssertionError("gap before keyword array accepted")
