@@ -23,6 +23,8 @@ name = r.backend_name()
 print("recordio backend:", name)
 assert name == "native", "native recordio failed to build"
 EOF
+  # the C predict ABI (deployment to C clients)
+  make -C src/c_predict
 }
 
 run_test() {

@@ -72,6 +72,21 @@ def _check_fork_safe_ndarray():
             "host), or use thread_pool=True / num_workers=0.")
 
 
+def _accel_backend_initialized():
+    """True iff an accelerator backend is ALREADY live in this
+    process.  Never initializes one: probing via jax.default_backend()
+    would itself claim the device and spawn the runtime threads whose
+    post-fork use the flag exists to prevent; an uninitialized jax is
+    fork-safe by definition."""
+    try:
+        from jax._src import xla_bridge as _xb
+        backends = getattr(_xb, "_backends", None) or {}
+        return any(p != "cpu" for p in backends)
+    except Exception:
+        import jax
+        return jax.default_backend() != "cpu"
+
+
 def _dtype_from_name(name):
     """dtype.name round-trip that also covers ml_dtypes extension
     dtypes (bfloat16, fp8...), whose .str is an opaque void code."""
@@ -266,8 +281,7 @@ class DataLoader:
         # are reclaimed by the glob below once the workers are dead
         prefix = "%s%x_%s_" % (_SHM_PREFIX, os.getpid(),
                                os.urandom(4).hex())
-        import jax
-        accel = jax.default_backend() != "cpu"
+        accel = _accel_backend_initialized()
         with warnings.catch_warnings():
             # the at-fork warnings (jax's RuntimeWarning, CPython
             # 3.12's multi-threaded-fork DeprecationWarning) do not
@@ -278,11 +292,28 @@ class DataLoader:
                 initargs=(self._dataset, worker_batchify, prefix,
                           accel))
         try:
+            initial_pids = {w.pid for w in getattr(pool, "_pool", [])}
             for res in _bounded_window(
                     self._batch_sampler,
                     lambda idxs: pool.apply_async(_worker_fn, (idxs,)),
                     2 * self._num_workers):
-                yield promote(_from_shm(res.get()))
+                # poll with a timeout: if a worker dies hard (native
+                # segfault, OOM-kill), Pool respawns it but the lost
+                # task's result never arrives — a bare get() would
+                # hang the training loop forever
+                while True:
+                    try:
+                        desc = res.get(5.0)
+                        break
+                    except _mp.TimeoutError:
+                        pids = {w.pid
+                                for w in getattr(pool, "_pool", [])}
+                        if pids != initial_pids:
+                            raise RuntimeError(
+                                "a DataLoader worker died; check "
+                                "dataset __getitem__/batchify_fn for "
+                                "crashes in native code or OOM")
+                yield promote(_from_shm(desc))
         finally:
             pool.terminate()
             pool.join()

@@ -1,0 +1,323 @@
+/*
+ * Native deployment ABI over the framework's Python Predictor
+ * (ref role: src/c_api/c_predict_api.cc — but embedding CPython
+ * rather than reimplementing the executor: the XLA-compiled forward
+ * IS the native fast path; this layer only marshals buffers).
+ *
+ * Threading model: every entry point takes the GIL via
+ * PyGILState_Ensure, so C clients may call from any thread.  When
+ * loaded into an existing Python process (e.g. via ctypes) the
+ * already-running interpreter is reused.
+ */
+#include "c_predict_api.h"
+
+#include <Python.h>
+
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_last_error;
+
+/* Python-side glue: marshals C buffers to the Predictor. */
+const char *kGlueSource = R"PY(
+import os
+import tempfile
+
+import numpy as np
+
+if os.environ.get("MXTPU_FORCE_CPU"):
+    # embedded standalone clients (tests, CI) that must not touch an
+    # accelerator: pin the host platform before the first jax use
+    os.environ.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    try:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+
+class _CPred(object):
+    def __init__(self, sym_json, param_bytes, shapes, dev_type, dev_id):
+        import incubator_mxnet_tpu as mx
+        from incubator_mxnet_tpu.predictor import Predictor
+        ctx = mx.cpu(dev_id) if dev_type == 1 else mx.tpu(dev_id)
+        f = tempfile.NamedTemporaryFile(delete=False, suffix=".params")
+        try:
+            f.write(param_bytes)
+            f.close()
+            self._pred = Predictor(sym_json, f.name, shapes, ctx=ctx)
+        finally:
+            os.unlink(f.name)
+        self._shapes = dict(shapes)
+
+    def set_input(self, key, mv, size):
+        shape = self._shapes[key]
+        arr = np.frombuffer(mv, dtype=np.float32, count=size)
+        self._pred.set_input(key, arr.reshape(shape).copy())
+
+    def forward(self):
+        self._pred.forward()
+
+    def output_shape(self, index):
+        return tuple(int(d) for d in
+                     self._pred.get_output(index).shape)
+
+    def read_output(self, index, mv, size):
+        out = np.asarray(self._pred.get_output(index).asnumpy(),
+                         dtype=np.float32).ravel()
+        if out.size != size:
+            raise ValueError(
+                "output %d has %d elements, caller buffer holds %d"
+                % (index, out.size, size))
+        dst = np.frombuffer(mv, dtype=np.float32, count=size)
+        dst[:] = out
+
+    def reshape(self, shapes):
+        clone = _CPred.__new__(_CPred)
+        clone._pred = self._pred.reshape(shapes)
+        clone._shapes = dict(shapes)
+        return clone
+)PY";
+
+PyObject *g_glue_ns = nullptr;   /* dict holding _CPred */
+bool g_owns_interpreter = false;
+
+struct PredHandle {
+  PyObject *obj;                 /* _CPred instance */
+  std::vector<mx_uint> shape;    /* last queried output shape */
+};
+
+class GIL {
+ public:
+  GIL() : state_(PyGILState_Ensure()) {}
+  ~GIL() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  g_last_error = "unknown python error";
+  if (value != nullptr) {
+    PyObject *s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char *c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) g_last_error = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+/* Initialize (or attach to) the interpreter and compile the glue.
+   Serialized: concurrent first calls from multiple client threads
+   must not race Py_InitializeEx or the g_glue_ns publication.  No
+   lock inversion with the GIL: callers never hold the GIL here (C
+   threads don't own it; a ctypes caller released it for the call). */
+int ensure_runtime() {
+  static std::mutex mu;
+  std::lock_guard<std::mutex> lock(mu);
+  if (g_glue_ns != nullptr) return 0;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    g_owns_interpreter = true;
+    /* release the GIL the init call left held; entry points
+       re-acquire it via PyGILState_Ensure */
+    PyEval_SaveThread();
+  }
+  GIL gil;
+  PyObject *ns = PyDict_New();
+  if (ns == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  PyDict_SetItemString(ns, "__builtins__", PyEval_GetBuiltins());
+  PyObject *r = PyRun_String(kGlueSource, Py_file_input, ns, ns);
+  if (r == nullptr) {
+    set_error_from_python();
+    Py_DECREF(ns);
+    return -1;
+  }
+  Py_DECREF(r);
+  g_glue_ns = ns;
+  return 0;
+}
+
+PyObject *shapes_dict(mx_uint num, const char **keys,
+                      const mx_uint *indptr, const mx_uint *data) {
+  PyObject *d = PyDict_New();
+  if (d == nullptr) return nullptr;
+  for (mx_uint i = 0; i < num; ++i) {
+    mx_uint ndim = indptr[i + 1] - indptr[i];
+    PyObject *t = PyTuple_New(ndim);
+    for (mx_uint j = 0; j < ndim; ++j) {
+      PyTuple_SET_ITEM(
+          t, j, PyLong_FromUnsignedLong(data[indptr[i] + j]));
+    }
+    if (PyDict_SetItemString(d, keys[i], t) != 0) {
+      Py_DECREF(t);
+      Py_DECREF(d);
+      return nullptr;
+    }
+    Py_DECREF(t);
+  }
+  return d;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char *MXTPUGetLastError(void) { return g_last_error.c_str(); }
+
+int MXTPUPredCreate(const char *symbol_json, const void *param_bytes,
+                    int param_size, int dev_type, int dev_id,
+                    mx_uint num_input_nodes, const char **input_keys,
+                    const mx_uint *input_shape_indptr,
+                    const mx_uint *input_shape_data,
+                    PredictorHandle *out) {
+  if (ensure_runtime() != 0) return -1;
+  GIL gil;
+  PyObject *shapes = shapes_dict(num_input_nodes, input_keys,
+                                 input_shape_indptr, input_shape_data);
+  if (shapes == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject *cls = PyDict_GetItemString(g_glue_ns, "_CPred");
+  PyObject *bytes = PyBytes_FromStringAndSize(
+      static_cast<const char *>(param_bytes), param_size);
+  PyObject *obj =
+      bytes == nullptr
+          ? nullptr
+          : PyObject_CallFunction(cls, "sOOii", symbol_json, bytes,
+                                  shapes, dev_type, dev_id);
+  Py_XDECREF(bytes);
+  Py_DECREF(shapes);
+  if (obj == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  auto *h = new PredHandle();
+  h->obj = obj;
+  *out = h;
+  return 0;
+}
+
+int MXTPUPredSetInput(PredictorHandle handle, const char *key,
+                      const float *data, mx_uint size) {
+  auto *h = static_cast<PredHandle *>(handle);
+  GIL gil;
+  PyObject *mv = PyMemoryView_FromMemory(
+      reinterpret_cast<char *>(const_cast<float *>(data)),
+      static_cast<Py_ssize_t>(size) * sizeof(float), PyBUF_READ);
+  PyObject *r = mv == nullptr
+                    ? nullptr
+                    : PyObject_CallMethod(h->obj, "set_input", "sOI",
+                                          key, mv, size);
+  Py_XDECREF(mv);
+  if (r == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPUPredForward(PredictorHandle handle) {
+  auto *h = static_cast<PredHandle *>(handle);
+  GIL gil;
+  PyObject *r = PyObject_CallMethod(h->obj, "forward", nullptr);
+  if (r == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPUPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                            mx_uint **shape_data,
+                            mx_uint *shape_ndim) {
+  auto *h = static_cast<PredHandle *>(handle);
+  GIL gil;
+  PyObject *t = PyObject_CallMethod(h->obj, "output_shape", "I", index);
+  if (t == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  h->shape.clear();
+  for (Py_ssize_t i = 0; i < PyTuple_Size(t); ++i) {
+    h->shape.push_back(static_cast<mx_uint>(
+        PyLong_AsUnsignedLong(PyTuple_GET_ITEM(t, i))));
+  }
+  Py_DECREF(t);
+  *shape_data = h->shape.data();
+  *shape_ndim = static_cast<mx_uint>(h->shape.size());
+  return 0;
+}
+
+int MXTPUPredGetOutput(PredictorHandle handle, mx_uint index,
+                       float *data, mx_uint size) {
+  auto *h = static_cast<PredHandle *>(handle);
+  GIL gil;
+  PyObject *mv = PyMemoryView_FromMemory(
+      reinterpret_cast<char *>(data),
+      static_cast<Py_ssize_t>(size) * sizeof(float), PyBUF_WRITE);
+  PyObject *r = mv == nullptr
+                    ? nullptr
+                    : PyObject_CallMethod(h->obj, "read_output", "IOI",
+                                          index, mv, size);
+  Py_XDECREF(mv);
+  if (r == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPUPredReshape(mx_uint num_input_nodes, const char **input_keys,
+                     const mx_uint *input_shape_indptr,
+                     const mx_uint *input_shape_data,
+                     PredictorHandle handle, PredictorHandle *out) {
+  auto *h = static_cast<PredHandle *>(handle);
+  GIL gil;
+  PyObject *shapes = shapes_dict(num_input_nodes, input_keys,
+                                 input_shape_indptr, input_shape_data);
+  PyObject *obj = shapes == nullptr
+                      ? nullptr
+                      : PyObject_CallMethod(h->obj, "reshape", "O",
+                                            shapes);
+  Py_XDECREF(shapes);
+  if (obj == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  auto *nh = new PredHandle();
+  nh->obj = obj;
+  *out = nh;
+  return 0;
+}
+
+int MXTPUPredFree(PredictorHandle handle) {
+  auto *h = static_cast<PredHandle *>(handle);
+  {
+    GIL gil;
+    Py_XDECREF(h->obj);
+  }
+  delete h;
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,75 @@
+/*
+ * C predict ABI (ref role: include/mxnet/c_predict_api.h — the 15
+ * MXPred* functions serving exported models to C/C++ programs).
+ *
+ * This is NOT a port of the reference header: it is a fresh ABI over
+ * the TPU framework's Python Predictor, embedding the interpreter in
+ * the host process (libpython).  A C client links libmxtpu_predict.so
+ * and never sees Python.
+ *
+ * Device types: 1 = cpu, 2 = tpu.
+ * All functions return 0 on success, -1 on failure; call
+ * MXTPUGetLastError() for the message.
+ */
+#ifndef MXTPU_C_PREDICT_API_H_
+#define MXTPU_C_PREDICT_API_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef unsigned int mx_uint;
+typedef void *PredictorHandle;
+
+/* Human-readable message for the last failed call in this thread. */
+const char *MXTPUGetLastError(void);
+
+/* Create a predictor from exported artifacts:
+ *   symbol_json  : contents of the *-symbol.json file
+ *   param_bytes  : contents of the *.params file (arg:/aux: keys)
+ *   dev_type     : 1 cpu, 2 tpu;  dev_id: device ordinal
+ *   num_input_nodes, input_keys: the graph's data inputs
+ *   input_shape_indptr/input_shape_data: CSR-packed shapes, i.e.
+ *     shape of input i = data[indptr[i] .. indptr[i+1]]
+ */
+int MXTPUPredCreate(const char *symbol_json, const void *param_bytes,
+                    int param_size, int dev_type, int dev_id,
+                    mx_uint num_input_nodes, const char **input_keys,
+                    const mx_uint *input_shape_indptr,
+                    const mx_uint *input_shape_data,
+                    PredictorHandle *out);
+
+/* Copy `size` floats into the named input (row-major, must match the
+ * shape declared at create time). */
+int MXTPUPredSetInput(PredictorHandle handle, const char *key,
+                      const float *data, mx_uint size);
+
+/* Run the compiled forward pass (first call compiles; later calls
+ * are a single device execution). */
+int MXTPUPredForward(PredictorHandle handle);
+
+/* Shape of output `index`; pointers are valid until the next call on
+ * this handle. */
+int MXTPUPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                            mx_uint **shape_data, mx_uint *shape_ndim);
+
+/* Copy output `index` (as float32) into caller memory of `size`
+ * floats. */
+int MXTPUPredGetOutput(PredictorHandle handle, mx_uint index,
+                       float *data, mx_uint size);
+
+/* Rebind for new input shapes (weights carry over); returns a new
+ * handle, the old one stays valid. */
+int MXTPUPredReshape(mx_uint num_input_nodes, const char **input_keys,
+                     const mx_uint *input_shape_indptr,
+                     const mx_uint *input_shape_data,
+                     PredictorHandle handle, PredictorHandle *out);
+
+/* Release the predictor. */
+int MXTPUPredFree(PredictorHandle handle);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* MXTPU_C_PREDICT_API_H_ */

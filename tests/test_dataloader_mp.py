@@ -169,6 +169,24 @@ def test_mp_loader_explicit_default_batchify_is_safe():
     assert batch[0].shape == (4, 3, 4)
 
 
+class _DyingDataset(gluon.data.Dataset):
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, idx):
+        if idx == 3:
+            os._exit(1)        # simulate a native crash / OOM kill
+        return np.zeros(2, "float32")
+
+
+def test_mp_loader_dead_worker_raises_not_hangs():
+    loader = gluon.data.DataLoader(_DyingDataset(), batch_size=2,
+                                   num_workers=2)
+    with pytest.raises(RuntimeError, match="worker died"):
+        for _ in loader:
+            pass
+
+
 def test_thread_pool_mode_still_works():
     loader = gluon.data.DataLoader(_PidDataset(), batch_size=4,
                                    num_workers=2, thread_pool=True)
