@@ -113,6 +113,92 @@ def _probe_accelerator(retries=3, delay=10.0, timeout_s=180.0):
     sys.exit(1)
 
 
+def _bench_transformer(dev, platform):
+    """Secondary headline: decoder-LM training step MFU.  ResNet-50 is
+    HBM-bound at ~0.12-0.15 MFU on one chip (PERF.md); the >=0.55 MFU
+    north star is a matmul-dominated workload, which this measures.
+    Run with MXTPU_BENCH_MODEL=transformer."""
+    import jax
+    import jax.numpy as jnp
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import parallel
+    from incubator_mxnet_tpu.gluon.model_zoo.transformer import \
+        TransformerLM
+
+    cpu = jax.devices("cpu")[0]
+    B = int(os.environ.get("MXTPU_BENCH_BATCH", "8"))
+    L = int(os.environ.get("MXTPU_BENCH_SEQ", "1024"))
+    V, D, LAYERS, HEADS = 32000, 1024, 12, 16
+
+    with jax.default_device(cpu):
+        mx.random.seed(0)
+        net = TransformerLM(V, d_model=D, n_layers=LAYERS,
+                            n_heads=HEADS, max_len=L)
+        net.initialize(mx.initializer.Xavier())
+        ex = mx.nd.array(np.zeros((2, L), "int32"))
+
+    def lm_loss(outputs, labels):
+        logits = outputs[0].astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        picked = jnp.take_along_axis(logp, labels[..., None], axis=-1)
+        return -jnp.mean(picked)
+
+    mesh_devs = [dev] if dev is not None else jax.devices("cpu")[:1]
+    compute_dtype = jnp.bfloat16 if platform != "cpu" else None
+    step = parallel.ShardedTrainStep(
+        net, optimizer="adam",
+        optimizer_params=dict(learning_rate=1e-4),
+        loss_fn=lm_loss, example_args=[ex],
+        mesh=parallel.make_mesh(devices=mesh_devs),
+        compute_dtype=compute_dtype)
+
+    rs = np.random.RandomState(0)
+    tgt = mesh_devs[0]
+    toks = jax.device_put(
+        np.asarray(rs.randint(0, V, (B, L)), np.int32), tgt)
+    labels = jax.device_put(
+        np.asarray(rs.randint(0, V, (B, L)), np.int32), tgt)
+    float(jax.device_get(toks.reshape(-1)[:1])[0])
+
+    warm, meas = 2, 10
+    t0 = time.perf_counter()
+    for _ in range(warm):
+        loss = step(toks, labels)
+    float(loss)
+    print(f"bench[transformer]: warmup+compile "
+          f"{time.perf_counter() - t0:.1f}s on {platform}",
+          file=sys.stderr)
+    t0 = time.perf_counter()
+    for _ in range(meas):
+        loss = step(toks, labels)
+    final_loss = float(loss)
+    dt = time.perf_counter() - t0
+
+    tok_s = B * L * meas / dt
+    peak = _peak_for(dev) if dev is not None else None
+    flops_tok = net.train_flops_per_token(L)
+    mfu = (flops_tok * tok_s / peak) if peak else None
+    assert np.isfinite(final_loss), final_loss
+    print(json.dumps({
+        "metric": f"transformer_lm_150m_train_tokens_per_sec_"
+                  f"batch{B}_seq{L}_1chip",
+        "value": round(tok_s, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": None,   # the reference predates transformers
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "platform": platform,
+        "device_kind": getattr(dev, "device_kind", "cpu")
+        if dev is not None else "cpu",
+        "step_ms": round(1e3 * dt / meas, 2),
+        "compute_dtype": "bfloat16" if compute_dtype else "float32",
+        "final_loss": round(final_loss, 4),
+        "model_tflops_per_step": round(flops_tok * B * L / 1e12, 3),
+        "achieved_tflops": round(flops_tok * tok_s / 1e12, 2),
+        "peak_tflops": round(peak / 1e12, 1) if peak else None,
+    }))
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -120,6 +206,10 @@ def main():
     dev = _probe_accelerator()
     cpu = jax.devices("cpu")[0]
     platform = dev.platform if dev is not None else "cpu"
+
+    if os.environ.get("MXTPU_BENCH_MODEL") == "transformer":
+        _bench_transformer(dev, platform)
+        return
 
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import parallel

@@ -95,16 +95,37 @@ def predict_mode():
 # ---------------------------------------------------------------------------
 
 
+class _ConstInput:
+    """Marker in TapeNode.inputs for a non-NDArray tensor argument
+    whose value was inlined at call time (raw numpy/list/scalar):
+    carries no gradient and cannot be rebound by get_symbol."""
+
+    def __repr__(self):
+        return "<const-input>"
+
+
+CONST_INPUT = _ConstInput()
+
+
 class TapeNode:
-    """One recorded op: the vjp closure plus input links."""
+    """One recorded op: the vjp closure plus input links.
 
-    __slots__ = ("vjp_fn", "inputs", "out_avals", "name")
+    ``op``/``params`` (set by the registry invoke path) identify the
+    recorded operator so get_symbol can re-trace the history into a
+    Symbol graph; closure-only nodes (CachedOp, custom Function)
+    leave them None."""
 
-    def __init__(self, vjp_fn, inputs, out_avals, name):
+    __slots__ = ("vjp_fn", "inputs", "out_avals", "name", "op",
+                 "params")
+
+    def __init__(self, vjp_fn, inputs, out_avals, name, op=None,
+                 params=None):
         self.vjp_fn = vjp_fn
         self.inputs = inputs        # list of NDArray (tensor inputs)
         self.out_avals = out_avals  # [(shape, dtype)] per output
         self.name = name
+        self.op = op
+        self.params = params
 
 
 def mark_variables(variables, gradients, grad_reqs="write"):
@@ -196,7 +217,7 @@ def _run_backward(heads, head_grads, variables=None, retain_graph=False):
         arg = tuple(outs_ct) if len(outs_ct) > 1 else outs_ct[0]
         in_cts = node.vjp_fn(arg)
         for inp, ct in zip(node.inputs, in_cts):
-            if inp is None or ct is None:
+            if inp is None or inp is CONST_INPUT or ct is None:
                 continue
             if isinstance(ct, np.ndarray) and ct.dtype == jax.dtypes.float0:
                 continue
@@ -246,10 +267,60 @@ def grad(heads, variables, head_grads=None, retain_graph=None,
 
 
 def get_symbol(x):
-    """Trace the recorded history of ``x`` into a Symbol — the analog
-    of autograd.get_symbol.  Currently returns None placeholder."""
-    raise NotImplementedError(
-        "get_symbol: use sym/hybridize tracing instead")
+    """Re-trace the recorded history of ``x`` into a Symbol (ref:
+    python/mxnet/autograd.py get_symbol — there via the C tape, here
+    by replaying the registry ops each TapeNode recorded).
+
+    Leaf arrays become Variables named var0, var1... in first-use
+    order.  Only registry-op history is traceable; CachedOp/custom
+    Function nodes recorded closures, not ops, and raise.
+    """
+    from .symbol import symbol as sym_mod
+
+    entry = getattr(x, "_autograd", None)
+    if entry is None:
+        return sym_mod.Variable("var0")
+
+    node_syms = {}     # id(node) -> Symbol with all outputs
+    arr_syms = {}      # id(leaf array) -> Variable symbol
+    counter = [0]
+
+    def leaf(arr):
+        if id(arr) not in arr_syms:
+            arr_syms[id(arr)] = sym_mod.Variable(f"var{counter[0]}")
+            counter[0] += 1
+        return arr_syms[id(arr)]
+
+    # dependencies-first order (toposort returns heads-first)
+    for node in reversed(_toposort([x])):
+        if node.op is None:
+            raise ValueError(
+                f"get_symbol: '{node.name}' was recorded as an opaque "
+                "closure (CachedOp/custom Function); only registry-op "
+                "history is traceable — hybridize the block instead")
+        sym_args = []
+        for inp in node.inputs:
+            if inp is None:
+                sym_args.append(None)
+                continue
+            if inp is CONST_INPUT:
+                raise ValueError(
+                    f"get_symbol: an input of '{node.name}' was an "
+                    "inlined constant (raw numpy/list/scalar), which "
+                    "a Symbol cannot re-bind — pass tensor inputs as "
+                    "NDArrays to make the history re-traceable")
+            e = getattr(inp, "_autograd", None)
+            if e is not None and id(e[0]) in node_syms:
+                s = node_syms[id(e[0])]
+                sym_args.append(s[e[1]] if len(s) > 1 else s)
+            else:
+                sym_args.append(leaf(inp))
+        node_syms[id(node)] = sym_mod._invoke(
+            node.op, sym_args, dict(node.params or {}))
+
+    node, idx = entry
+    s = node_syms[id(node)]
+    return s[idx] if len(s) > 1 else s
 
 
 class Function:

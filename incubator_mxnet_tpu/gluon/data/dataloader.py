@@ -21,7 +21,6 @@ already initialized an accelerator backend is only safe if the child
 never re-enters that runtime, so batchify inside workers produces
 numpy arrays and the parent promotes them to NDArray.
 """
-import collections
 import concurrent.futures as _futures
 import multiprocessing as _mp
 import os
@@ -32,6 +31,7 @@ import numpy as np
 
 from ...ndarray import array as nd_array
 from ...ndarray.ndarray import NDArray
+from ...utils.concurrent import bounded_window as _bounded_window
 from .sampler import BatchSampler, RandomSampler, SequentialSampler
 
 __all__ = ["DataLoader", "default_batchify_fn"]
@@ -74,17 +74,19 @@ def _check_fork_safe_ndarray():
 
 def _accel_backend_initialized():
     """True iff an accelerator backend is ALREADY live in this
-    process.  Never initializes one: probing via jax.default_backend()
-    would itself claim the device and spawn the runtime threads whose
-    post-fork use the flag exists to prevent; an uninitialized jax is
-    fork-safe by definition."""
+    process.  Must never initialize one (probing via
+    jax.default_backend() would itself claim the device and spawn the
+    runtime threads whose post-fork use the flag exists to prevent);
+    an uninitialized jax is fork-safe by definition.  If the probe
+    API is gone in a future jax, fail CLOSED (assume an accelerator)
+    rather than risk a silent post-fork deadlock."""
     try:
         from jax._src import xla_bridge as _xb
-        backends = getattr(_xb, "_backends", None) or {}
-        return any(p != "cpu" for p in backends)
+        if not _xb.backends_are_initialized():
+            return False
+        return any(p != "cpu" for p in _xb._backends)
     except Exception:
-        import jax
-        return jax.default_backend() != "cpu"
+        return True
 
 
 def _dtype_from_name(name):
@@ -200,26 +202,6 @@ def _worker_fn(indices):
     return _to_shm(batch, _worker_prefix)
 
 
-def _bounded_window(items, submit, max_inflight):
-    """Yield submitted handles in order with at most ``max_inflight``
-    outstanding: unconsumed batches hold memory (or /dev/shm
-    segments), so workers must not run a whole epoch ahead.  The
-    reference bounds its queue the same way (~2*num_workers)."""
-    inflight = collections.deque()
-    it = iter(items)
-    exhausted = False
-    while inflight or not exhausted:
-        while not exhausted and len(inflight) < max_inflight:
-            try:
-                item = next(it)
-            except StopIteration:
-                exhausted = True
-                break
-            inflight.append(submit(item))
-        if inflight:
-            yield inflight.popleft()
-
-
 class DataLoader:
     """(ref: dataloader.py DataLoader)"""
 
@@ -292,6 +274,8 @@ class DataLoader:
                 initargs=(self._dataset, worker_batchify, prefix,
                           accel))
         try:
+            import time as _time
+            grace = float(os.environ.get("MXTPU_DL_DEAD_GRACE", "60"))
             initial_pids = {w.pid for w in getattr(pool, "_pool", [])}
             for res in _bounded_window(
                     self._batch_sampler,
@@ -300,19 +284,36 @@ class DataLoader:
                 # poll with a timeout: if a worker dies hard (native
                 # segfault, OOM-kill), Pool respawns it but the lost
                 # task's result never arrives — a bare get() would
-                # hang the training loop forever
+                # hang the training loop forever.  A pid change alone
+                # is not proof THIS result is lost (the died worker
+                # may have held a different task), so the result gets
+                # a grace window after the first observed change.
+                deadline = None
                 while True:
                     try:
                         desc = res.get(5.0)
+                        # a completed batch proves the current worker
+                        # set is healthy: re-snapshot so an earlier
+                        # benign respawn can't trip later batches
+                        initial_pids = {
+                            w.pid for w in getattr(pool, "_pool", [])}
                         break
                     except _mp.TimeoutError:
                         pids = {w.pid
                                 for w in getattr(pool, "_pool", [])}
-                        if pids != initial_pids:
+                        if pids == initial_pids:
+                            continue
+                        if deadline is None:
+                            deadline = _time.monotonic() + grace
+                        elif _time.monotonic() > deadline:
                             raise RuntimeError(
-                                "a DataLoader worker died; check "
-                                "dataset __getitem__/batchify_fn for "
-                                "crashes in native code or OOM")
+                                "a DataLoader worker died and its "
+                                "batch never arrived (waited "
+                                f"{grace:.0f}s); check dataset "
+                                "__getitem__/batchify_fn for crashes "
+                                "in native code or OOM "
+                                "(MXTPU_DL_DEAD_GRACE overrides the "
+                                "wait)")
                 yield promote(_from_shm(desc))
         finally:
             pool.terminate()

@@ -1,0 +1,140 @@
+"""Decoder-only transformer LM built from framework layers/ops.
+
+A model family the reference era predates but today's users expect;
+built TPU-first: every matmul (qkv/proj/mlp/head and the two
+batch_dot attention products) lands on the MXU, shapes are static
+under jit, and the causal mask is an additive constant folded by XLA.
+Trains through the same paths as every other Block (Trainer,
+ShardedTrainStep's kvstore='tpu' mesh step, bf16 master-weight mode);
+for sequence-parallel scale-out the attention core swaps for
+parallel.ring_attention (see parallel/ring_attention.py).
+"""
+import math
+
+import numpy as np
+
+from ... import ndarray as nd
+from ..block import Block
+from ..nn import Dense, Dropout, Embedding, LayerNorm
+
+__all__ = ["TransformerLM", "TransformerBlock", "CausalSelfAttention",
+           "transformer_lm"]
+
+
+class CausalSelfAttention(Block):
+    """Multi-head causal self-attention over registry ops."""
+
+    def __init__(self, d_model, n_heads, **kwargs):
+        super().__init__(**kwargs)
+        assert d_model % n_heads == 0
+        self._d = d_model
+        self._h = n_heads
+        self._dh = d_model // n_heads
+        with self.name_scope():
+            self.qkv = Dense(3 * d_model, flatten=False, use_bias=True)
+            self.proj = Dense(d_model, flatten=False, use_bias=True)
+
+    def forward(self, x):
+        b, l, d = x.shape
+        h, dh = self._h, self._dh
+        qkv = self.qkv(x)                          # (B, L, 3D)
+        q, k, v = nd.split(qkv, num_outputs=3, axis=2)
+
+        def heads(t):                              # (B, L, D)->(B*H, L, Dh)
+            return t.reshape(b, l, h, dh).transpose(
+                (0, 2, 1, 3)).reshape(b * h, l, dh)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        scores = nd.batch_dot(q, k, transpose_b=True) / math.sqrt(dh)
+        mask = nd.array(np.triu(
+            np.full((l, l), -1e9, np.float32), k=1))
+        scores = nd.broadcast_add(scores, mask.expand_dims(0))
+        att = nd.softmax(scores, axis=-1)
+        out = nd.batch_dot(att, v)                 # (B*H, L, Dh)
+        out = out.reshape(b, h, l, dh).transpose(
+            (0, 2, 1, 3)).reshape(b, l, d)
+        return self.proj(out)
+
+
+class TransformerBlock(Block):
+    """Pre-norm attention + MLP with residuals (GPT-2 layout)."""
+
+    def __init__(self, d_model, n_heads, mlp_ratio=4, dropout=0.0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.ln1 = LayerNorm()
+            self.attn = CausalSelfAttention(d_model, n_heads)
+            self.ln2 = LayerNorm()
+            self.up = Dense(mlp_ratio * d_model, flatten=False,
+                            activation="relu")
+            self.down = Dense(d_model, flatten=False)
+            self.drop = Dropout(dropout)
+
+    def forward(self, x):
+        x = x + self.drop(self.attn(self.ln1(x)))
+        return x + self.drop(self.down(self.up(self.ln2(x))))
+
+
+class TransformerLM(Block):
+    """Token-in, logits-out decoder LM.
+
+    Parameters: vocab_size, d_model, n_layers, n_heads, max_len
+    (learned positions), mlp_ratio, dropout.
+    """
+
+    def __init__(self, vocab_size, d_model=512, n_layers=6,
+                 n_heads=8, max_len=1024, mlp_ratio=4, dropout=0.0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._d = d_model
+        self._max_len = max_len
+        with self.name_scope():
+            self.embed = Embedding(vocab_size, d_model)
+            self.pos = Embedding(max_len, d_model)
+            self.blocks = [
+                TransformerBlock(d_model, n_heads, mlp_ratio, dropout)
+                for _ in range(n_layers)]
+            for i, blk in enumerate(self.blocks):
+                setattr(self, f"block{i}", blk)   # register children
+            self.ln_f = LayerNorm()
+            self.head = Dense(vocab_size, flatten=False,
+                              use_bias=False)
+        self.n_layers = n_layers
+        self.n_heads = n_heads
+
+    def forward(self, tokens):
+        b, l = tokens.shape
+        if l > self._max_len:
+            raise ValueError(
+                f"sequence {l} exceeds max_len {self._max_len}")
+        pos = nd.arange(l).astype("int32")
+        x = self.embed(tokens) * math.sqrt(self._d)
+        x = nd.broadcast_add(x, self.pos(pos).expand_dims(0))
+        for blk in self.blocks:
+            x = blk(x)
+        return self.head(self.ln_f(x))
+
+    def train_flops_per_token(self, seq_len):
+        """Deterministic matmul-FLOPs per token for one fwd+bwd step
+        (the 3x-forward rule), for MFU accounting."""
+        d = self._d
+        per_layer = (2 * d * 3 * d          # qkv
+                     + 2 * d * d            # proj
+                     + 2 * 2 * seq_len * d  # scores + att@v
+                     + 2 * 2 * d * 4 * d)   # mlp up+down
+        vocab = self.head._units
+        fwd = self.n_layers * per_layer + 2 * d * vocab
+        return 3 * fwd
+
+
+def transformer_lm(vocab_size=32000, size="small", **kwargs):
+    """Factory: 'small' (125M-class), 'medium' (350M-class), or pass
+    explicit dims via kwargs."""
+    presets = {
+        "small": dict(d_model=768, n_layers=12, n_heads=12),
+        "medium": dict(d_model=1024, n_layers=24, n_heads=16),
+    }
+    cfg = dict(presets[size]) if size in presets else {}
+    cfg.update(kwargs)
+    return TransformerLM(vocab_size, **cfg)

@@ -2,16 +2,19 @@
 from ..autograd import TapeNode
 
 
-def make_node(op, vjp_fn, nd_inputs, all_outs, out_arrays, n_aux_out):
+def make_node(op, vjp_fn, nd_inputs, all_outs, out_arrays, n_aux_out,
+              params=None):
     """Create a tape node for one recorded op call.
 
     ``all_outs`` are every jnp output of the op fn (including trailing
     aux-state outputs); only the leading real outputs (``out_arrays``)
     get autograd entries — aux slots receive zero cotangents at
-    backward time.
+    backward time.  ``params`` are the user-facing op params, kept so
+    autograd.get_symbol can re-trace the call.
     """
     avals = [(tuple(o.shape), o.dtype) for o in all_outs]
-    node = TapeNode(vjp_fn, list(nd_inputs), avals, op.name)
+    node = TapeNode(vjp_fn, list(nd_inputs), avals, op.name, op=op,
+                    params=params)
     for i, arr in enumerate(out_arrays):
         arr._autograd = (node, i)
     return node

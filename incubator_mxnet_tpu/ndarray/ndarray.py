@@ -503,6 +503,7 @@ def imperative_invoke(op, args, kwargs, out=None):
         _prof.op_start()
     params = {k: v for k, v in kwargs.items()
               if v is not None and k not in ("name", "ctx")}
+    user_params = dict(params)   # pre-internal copy, for get_symbol
     ctx = kwargs.get("ctx")
     jargs = []
     nd_inputs = []
@@ -515,7 +516,7 @@ def imperative_invoke(op, args, kwargs, out=None):
             nd_inputs.append(None)
         else:
             jargs.append(jnp.asarray(a))
-            nd_inputs.append(None)
+            nd_inputs.append(autograd.CONST_INPUT)
 
     if op.needs_mode:
         params["_training"] = autograd.is_training()
@@ -526,7 +527,7 @@ def imperative_invoke(op, args, kwargs, out=None):
         return op.fn(*xs, **params)
 
     recording = (autograd.is_recording() and op.differentiable
-                 and any(n is not None for n in nd_inputs))
+                 and any(isinstance(n, NDArray) for n in nd_inputs))
     if recording:
         outs, vjp_fn = jax.vjp(fn, *jargs)
     else:
@@ -544,7 +545,7 @@ def imperative_invoke(op, args, kwargs, out=None):
         aux_new = outs_list[-n_aux_out:]
         outs_list = outs_list[:-n_aux_out]
         for nd_in, new in zip(nd_inputs[-op.num_aux:], aux_new):
-            if nd_in is not None:
+            if isinstance(nd_in, NDArray):
                 nd_in._data = new
 
     if ctx is not None and isinstance(ctx, Context):
@@ -552,15 +553,16 @@ def imperative_invoke(op, args, kwargs, out=None):
 
     engine.maybe_block(outs_list)
     out_ctx = ctx if isinstance(ctx, Context) else (
-        nd_inputs[0]._ctx if nd_inputs and nd_inputs[0] is not None
-        else None)
+        nd_inputs[0]._ctx
+        if nd_inputs and isinstance(nd_inputs[0], NDArray) else None)
     out_arrays = [NDArray(o, out_ctx) for o in outs_list]
 
     if recording:
         from .autograd_shim import make_node
         # pass ALL fn outputs (incl. trailing aux) so the vjp closure's
         # cotangent structure matches; aux slots get zero cotangents
-        make_node(op, vjp_fn, nd_inputs, all_outs, out_arrays, n_aux_out)
+        make_node(op, vjp_fn, nd_inputs, all_outs, out_arrays,
+                  n_aux_out, params=user_params)
 
     if _prof is not None:
         _prof.record_op(op.name, outs_list)

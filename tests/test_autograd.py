@@ -143,3 +143,54 @@ def test_second_order_unsupported_path():
         y = x * x
     (g,) = autograd.grad(y, [x], retain_graph=True)
     np.testing.assert_allclose(g.asnumpy(), [2.0])
+
+
+def test_get_symbol_retrace():
+    # imperative history -> Symbol -> executor must reproduce forward
+    import numpy as np
+    from incubator_mxnet_tpu import sym as sym_mod
+    a = mx.nd.array(np.random.RandomState(0).rand(2, 3).astype("float32"))
+    w = mx.nd.array(np.random.RandomState(1).rand(4, 3).astype("float32"))
+    b = mx.nd.array(np.zeros(4, "float32"))
+    with autograd.record():
+        y = mx.nd.FullyConnected(a, w, b, num_hidden=4)
+        z = mx.nd.Activation(y, act_type="relu") * 2.0
+    s = autograd.get_symbol(z)
+    args = s.list_arguments()
+    assert len(args) == 3, args
+    ex = s.simple_bind(mx.cpu(), **{args[0]: (2, 3), args[1]: (4, 3),
+                                    args[2]: (4,)})
+    ex.arg_dict[args[0]][:] = a
+    ex.arg_dict[args[1]][:] = w
+    ex.arg_dict[args[2]][:] = b
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(),
+                               z.asnumpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_get_symbol_leaf_and_opaque():
+    import numpy as np
+    import pytest
+    from incubator_mxnet_tpu import gluon
+    # un-recorded array -> a bare Variable
+    leafsym = autograd.get_symbol(mx.nd.ones((2,)))
+    assert leafsym.list_arguments() == ["var0"]
+    # CachedOp history is opaque and must say so
+    net = gluon.nn.Dense(2)
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.ones((1, 3))
+    net(x)  # build+cache
+    with autograd.record():
+        out = net(x)
+    with pytest.raises(ValueError, match="opaque"):
+        autograd.get_symbol(out)
+
+
+def test_get_symbol_rejects_inlined_constants():
+    import numpy as np
+    import pytest
+    x = mx.nd.ones((3,))
+    with autograd.record():
+        y = mx.nd.broadcast_add(x, np.ones(3, "float32"))
+    with pytest.raises(ValueError, match="constant"):
+        autograd.get_symbol(y)

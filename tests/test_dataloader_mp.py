@@ -179,7 +179,8 @@ class _DyingDataset(gluon.data.Dataset):
         return np.zeros(2, "float32")
 
 
-def test_mp_loader_dead_worker_raises_not_hangs():
+def test_mp_loader_dead_worker_raises_not_hangs(monkeypatch):
+    monkeypatch.setenv("MXTPU_DL_DEAD_GRACE", "2")
     loader = gluon.data.DataLoader(_DyingDataset(), batch_size=2,
                                    num_workers=2)
     with pytest.raises(RuntimeError, match="worker died"):

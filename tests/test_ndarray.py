@@ -163,3 +163,15 @@ def test_sparse_roundtrip():
     assert r.stype == "row_sparse"
     back = c.tostype("default")
     assert back.stype == "default"
+
+
+def test_constant_first_arg():
+    # raw numpy in the FIRST tensor slot: ctx inference and autograd
+    # must treat it as an inlined constant, not an NDArray
+    x = nd.array(np.arange(3, dtype="float32"))
+    out = nd.broadcast_add(np.ones(3, "float32"), x)
+    np.testing.assert_allclose(out.asnumpy(), [1, 2, 3])
+    from incubator_mxnet_tpu import autograd
+    with autograd.record():
+        y = nd.broadcast_mul(np.full(3, 2.0, "float32"), x)
+    assert np.all(np.isfinite(y.asnumpy()))

@@ -241,3 +241,23 @@ def test_record_iter_round_batch_wraps():
         assert [b.pad for b in batches] == [0, 0, 6]
         tail = batches[-1].data[0].asnumpy()
         assert np.abs(tail[4:]).sum() > 0  # wrapped, not zero-padded
+
+
+def test_im2rec_multithreaded_matches_serial():
+    # --num-thread encodes on a pool but the writer stays in list
+    # order, so the .rec/.idx must be byte-identical to serial
+    with tempfile.TemporaryDirectory() as td:
+        _make_rec_dataset(td)
+        import im2rec
+        root = os.path.join(td, "data")
+        p1 = os.path.join(td, "serial")
+        p2 = os.path.join(td, "mt")
+        im2rec.make_list(p1, root)
+        im2rec.make_list(p2, root)
+        n1 = im2rec.pack(p1, root, resize=32, num_thread=1)
+        n2 = im2rec.pack(p2, root, resize=32, num_thread=4)
+        assert n1 == n2 == 24
+        with open(p1 + ".rec", "rb") as a, open(p2 + ".rec", "rb") as b:
+            assert a.read() == b.read()
+        with open(p1 + ".idx") as a, open(p2 + ".idx") as b:
+            assert a.read() == b.read()

@@ -1,0 +1,93 @@
+"""TransformerLM model family (gluon/model_zoo/transformer.py):
+causal attention semantics, convergence through the mesh train step,
+and bf16 mixed-precision."""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import parallel
+from incubator_mxnet_tpu.gluon.model_zoo.transformer import (
+    TransformerLM, transformer_lm)
+
+
+def _tiny(vocab=37, **kw):
+    cfg = dict(d_model=32, n_layers=2, n_heads=4, max_len=16)
+    cfg.update(kw)
+    mx.random.seed(0)
+    net = TransformerLM(vocab, **cfg)
+    net.initialize(mx.initializer.Xavier())
+    return net
+
+
+def _lm_loss(outputs, labels):
+    logits = outputs[0].astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(
+        jnp.take_along_axis(logp, labels[..., None], axis=-1))
+
+
+def test_forward_shape_and_determinism():
+    net = _tiny()
+    toks = mx.nd.array(np.random.RandomState(0)
+                       .randint(0, 37, (2, 8)).astype("int32"))
+    out = net(toks)
+    assert out.shape == (2, 8, 37)
+    np.testing.assert_allclose(out.asnumpy(), net(toks).asnumpy())
+
+
+def test_causality():
+    # changing a future token must not change earlier logits
+    net = _tiny()
+    rs = np.random.RandomState(1)
+    a = rs.randint(0, 37, (1, 8)).astype("int32")
+    b = a.copy()
+    b[0, 5:] = (b[0, 5:] + 7) % 37
+    oa = net(mx.nd.array(a)).asnumpy()
+    ob = net(mx.nd.array(b)).asnumpy()
+    np.testing.assert_allclose(oa[0, :5], ob[0, :5], atol=1e-5)
+    assert np.abs(oa[0, 5:] - ob[0, 5:]).max() > 1e-4
+
+
+def test_trains_on_mesh():
+    net = _tiny()
+    rs = np.random.RandomState(0)
+    toks = jnp.asarray(rs.randint(0, 37, (8, 8)), jnp.int32)
+    labels = jnp.asarray(rs.randint(0, 37, (8, 8)), jnp.int32)
+    step = parallel.ShardedTrainStep(
+        net, optimizer="adam",
+        optimizer_params=dict(learning_rate=1e-2), loss_fn=_lm_loss,
+        example_args=[mx.nd.array(np.zeros((2, 8), "int32"))])
+    losses = [float(step(toks, labels)) for _ in range(25)]
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_bf16_compute_path():
+    net = _tiny()
+    rs = np.random.RandomState(0)
+    toks = jnp.asarray(rs.randint(0, 37, (8, 8)), jnp.int32)
+    labels = jnp.asarray(rs.randint(0, 37, (8, 8)), jnp.int32)
+    step = parallel.ShardedTrainStep(
+        net, optimizer="sgd",
+        optimizer_params=dict(learning_rate=0.1), loss_fn=_lm_loss,
+        example_args=[mx.nd.array(np.zeros((2, 8), "int32"))],
+        compute_dtype=jnp.bfloat16)
+    l0 = float(step(toks, labels))
+    l1 = float(step(toks, labels))
+    assert np.isfinite(l0) and np.isfinite(l1)
+    # masters stay fp32
+    assert all(v.dtype == jnp.float32 for v in step.params.values())
+
+
+def test_factory_presets():
+    net = transformer_lm(vocab_size=100, size="small", n_layers=1,
+                        max_len=8)
+    assert net.n_layers == 1 and net._d == 768
+
+
+def test_max_len_guard():
+    net = _tiny(max_len=8)
+    import pytest
+    with pytest.raises(ValueError, match="max_len"):
+        net(mx.nd.array(np.zeros((1, 9), "int32")))

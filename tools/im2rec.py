@@ -42,34 +42,73 @@ def make_list(prefix, root, recursive=True):
     return prefix + ".lst"
 
 
-def pack(prefix, root, lst_path=None, quality=95, resize=0):
-    """Pack list entries into PREFIX.rec + PREFIX.idx."""
+def _encode_entry(parts, root, quality, resize):
+    """Worker half of pack(): decode, resize, JPEG-encode one entry.
+    Pure PIL/numpy (GIL released during codec work), so a thread pool
+    scales it like the reference's --num-thread encoder threads."""
     from incubator_mxnet_tpu import recordio as rio
-    from incubator_mxnet_tpu.image import resize_short
-    from incubator_mxnet_tpu.ndarray import array as nd_array
     import numpy as np
     from PIL import Image
 
+    idx = int(parts[0])
+    labels = [float(x) for x in parts[1:-1]]
+    path = os.path.join(root, parts[-1])
+    img = Image.open(path).convert("RGB")
+    if resize:
+        # identical geometry to image.resize_short (short edge pinned
+        # to `resize`, long edge int-truncated) so packed dims match
+        # the framework's own resize path
+        w, h = img.size
+        if h > w:
+            w, h = resize, int(h * resize / w)
+        else:
+            w, h = int(w * resize / h), resize
+        img = img.resize((w, h), Image.BILINEAR)
+    label = labels[0] if len(labels) == 1 else labels
+    header = rio.IRHeader(0, label, idx, 0)
+    return idx, rio.pack_img(header, np.asarray(img), quality=quality)
+
+
+def pack(prefix, root, lst_path=None, quality=95, resize=0,
+         num_thread=1):
+    """Pack list entries into PREFIX.rec + PREFIX.idx.
+
+    With num_thread > 1, decode/resize/encode runs on a thread pool
+    (the reference im2rec.py --num-thread / im2rec.cc worker model)
+    while this thread writes records in list order, with a bounded
+    in-flight window for backpressure.
+    """
+    import concurrent.futures as futures
+
+    from incubator_mxnet_tpu import recordio as rio
+    from incubator_mxnet_tpu.utils.concurrent import bounded_window
+
     lst_path = lst_path or prefix + ".lst"
+    with open(lst_path) as f:
+        entries = [line.strip().split("\t") for line in f]
+    entries = [p for p in entries if len(p) >= 3]
+
     rec = rio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
     n = 0
-    with open(lst_path) as f:
-        for line in f:
-            parts = line.strip().split("\t")
-            if len(parts) < 3:
-                continue
-            idx = int(parts[0])
-            labels = [float(x) for x in parts[1:-1]]
-            path = os.path.join(root, parts[-1])
-            img = np.asarray(Image.open(path).convert("RGB"))
-            if resize:
-                img = resize_short(nd_array(img), resize).asnumpy()
-            label = labels[0] if len(labels) == 1 else labels
-            header = rio.IRHeader(0, label, idx, 0)
-            rec.write_idx(idx, rio.pack_img(header, img,
-                                            quality=quality))
-            n += 1
-    rec.close()
+    try:
+        if num_thread <= 1:
+            for parts in entries:
+                idx, payload = _encode_entry(parts, root, quality,
+                                             resize)
+                rec.write_idx(idx, payload)
+                n += 1
+        else:
+            with futures.ThreadPoolExecutor(num_thread) as pool:
+                for fut in bounded_window(
+                        entries,
+                        lambda p: pool.submit(_encode_entry, p, root,
+                                              quality, resize),
+                        4 * num_thread):
+                    idx, payload = fut.result()
+                    rec.write_idx(idx, payload)
+                    n += 1
+    finally:
+        rec.close()
     return n
 
 
@@ -81,6 +120,8 @@ def main():
                     help="generate the .lst only")
     ap.add_argument("--quality", type=int, default=95)
     ap.add_argument("--resize", type=int, default=0)
+    ap.add_argument("--num-thread", type=int, default=1,
+                    help="encoder threads (writer stays in-order)")
     args = ap.parse_args()
     if args.list:
         path = make_list(args.prefix, args.root)
@@ -89,7 +130,7 @@ def main():
         if not os.path.exists(args.prefix + ".lst"):
             make_list(args.prefix, args.root)
         n = pack(args.prefix, args.root, quality=args.quality,
-                 resize=args.resize)
+                 resize=args.resize, num_thread=args.num_thread)
         print(f"packed {n} records into {args.prefix}.rec")
 
 
