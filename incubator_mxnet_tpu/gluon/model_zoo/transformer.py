@@ -22,23 +22,71 @@ __all__ = ["TransformerLM", "TransformerBlock", "CausalSelfAttention",
 
 
 class CausalSelfAttention(Block):
-    """Multi-head causal self-attention over registry ops."""
+    """Multi-head causal self-attention over registry ops.
 
-    def __init__(self, d_model, n_heads, **kwargs):
+    With ``seq_parallel=True`` and an ambient mesh whose 'sp' axis is
+    >1 (``parallel.use_mesh``), the attention core runs as ring
+    attention over the sequence axis (parallel/ring_attention.py):
+    K/V blocks rotate around the ring via ppermute while each shard
+    holds only L/sp of the sequence — the long-context scale-out
+    path.  Falls back to exact local attention off-mesh, and both
+    paths compute identical values.
+    """
+
+    def __init__(self, d_model, n_heads, seq_parallel=False,
+                 **kwargs):
         super().__init__(**kwargs)
         assert d_model % n_heads == 0
         self._d = d_model
         self._h = n_heads
         self._dh = d_model // n_heads
+        self._seq_parallel = seq_parallel
         with self.name_scope():
             self.qkv = Dense(3 * d_model, flatten=False, use_bias=True)
             self.proj = Dense(d_model, flatten=False, use_bias=True)
+
+    def _ring_mesh(self, seq_len):
+        """The mesh to ring over, or None to use exact local
+        attention.  Ring requires: the flag, an ambient mesh with
+        sp>1, a divisible sequence, and NOT an eager tape-recording
+        pass — the raw-jax ring call is invisible to the imperative
+        autograd tape, so eager record()/backward() must take the
+        registry-op path (identical values, correct gradients); the
+        compiled ShardedTrainStep path differentiates through ring
+        via jax.grad and keeps it."""
+        if not self._seq_parallel:
+            return None
+        from ... import autograd
+        if autograd.is_recording():
+            return None
+        from ...parallel.mesh import current_mesh
+        mesh = current_mesh()
+        if (mesh is None or mesh.shape.get("sp", 1) <= 1
+                or seq_len % mesh.shape["sp"] != 0):
+            return None
+        return mesh
 
     def forward(self, x):
         b, l, d = x.shape
         h, dh = self._h, self._dh
         qkv = self.qkv(x)                          # (B, L, 3D)
         q, k, v = nd.split(qkv, num_outputs=3, axis=2)
+
+        mesh = self._ring_mesh(l)
+        if mesh is not None:
+            import jax
+            from ...parallel import ring_attention
+            out = ring_attention(
+                q.reshape(b, l, h, dh)._data,
+                k.reshape(b, l, h, dh)._data,
+                v.reshape(b, l, h, dh)._data, mesh, causal=True)
+            if not isinstance(out, jax.core.Tracer):
+                # eager: gather off the mesh so downstream ops can mix
+                # with single-device parameters (under jit the step's
+                # shardings govern instead)
+                out = jax.device_put(
+                    out, list(x._data.devices())[0])
+            return self.proj(nd.NDArray(out).reshape(b, l, d))
 
         def heads(t):                              # (B, L, D)->(B*H, L, Dh)
             return t.reshape(b, l, h, dh).transpose(
@@ -60,11 +108,12 @@ class TransformerBlock(Block):
     """Pre-norm attention + MLP with residuals (GPT-2 layout)."""
 
     def __init__(self, d_model, n_heads, mlp_ratio=4, dropout=0.0,
-                 **kwargs):
+                 seq_parallel=False, **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
             self.ln1 = LayerNorm()
-            self.attn = CausalSelfAttention(d_model, n_heads)
+            self.attn = CausalSelfAttention(d_model, n_heads,
+                                            seq_parallel=seq_parallel)
             self.ln2 = LayerNorm()
             self.up = Dense(mlp_ratio * d_model, flatten=False,
                             activation="relu")
@@ -85,7 +134,7 @@ class TransformerLM(Block):
 
     def __init__(self, vocab_size, d_model=512, n_layers=6,
                  n_heads=8, max_len=1024, mlp_ratio=4, dropout=0.0,
-                 **kwargs):
+                 seq_parallel=False, **kwargs):
         super().__init__(**kwargs)
         self._d = d_model
         self._max_len = max_len
@@ -93,7 +142,8 @@ class TransformerLM(Block):
             self.embed = Embedding(vocab_size, d_model)
             self.pos = Embedding(max_len, d_model)
             self.blocks = [
-                TransformerBlock(d_model, n_heads, mlp_ratio, dropout)
+                TransformerBlock(d_model, n_heads, mlp_ratio, dropout,
+                                 seq_parallel=seq_parallel)
                 for _ in range(n_layers)]
             for i, blk in enumerate(self.blocks):
                 setattr(self, f"block{i}", blk)   # register children
@@ -114,6 +164,146 @@ class TransformerLM(Block):
         for blk in self.blocks:
             x = blk(x)
         return self.head(self.ln_f(x))
+
+    # ------------------------------------------------------------ decode
+    def generate(self, tokens, max_new_tokens, temperature=0.0,
+                 rng=None):
+        """Autoregressive decode with a KV cache, TPU-native: ONE
+        ``lax.scan`` over positions (teacher-forced through the
+        prompt, then sampling), static shapes throughout, compiled
+        once per (batch, prompt_len, max_new_tokens) signature.
+
+        tokens : (B, P) int NDArray/numpy prompt
+        temperature : 0 -> greedy argmax, >0 -> categorical sample
+        returns (B, P + max_new_tokens) int32 NDArray
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        toks_np = np.asarray(
+            tokens.asnumpy() if hasattr(tokens, "asnumpy")
+            else tokens).astype(np.int32)
+        b, p = toks_np.shape
+        total = p + int(max_new_tokens)
+        if total > self._max_len:
+            raise ValueError(
+                f"prompt+new = {total} exceeds max_len "
+                f"{self._max_len}")
+
+        try:
+            wts = self._decode_weights()
+        except Exception:
+            # deferred-init params (LayerNorm shapes): settle with a
+            # tiny probe forward, as functionalize does
+            from ... import autograd
+            with autograd.pause():
+                self.forward(nd.NDArray(jnp.zeros((1, 1), jnp.int32)))
+            wts = self._decode_weights()
+
+        key = (b, p, int(max_new_tokens), temperature > 0)
+        cache = getattr(self, "_gen_cache", None)
+        if cache is None:
+            cache = self._gen_cache = {}
+        if key not in cache:
+            cache[key] = jax.jit(self._build_decode(
+                b, p, int(max_new_tokens), temperature > 0))
+        fn = cache[key]
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        out = fn(wts, jnp.asarray(toks_np),
+                 jnp.asarray(float(temperature or 1.0), jnp.float32),
+                 rng)
+        return nd.NDArray(out)
+
+    def _decode_weights(self):
+        def w(param):
+            return param.data()._data
+
+        layers = []
+        for blk in self.blocks:
+            layers.append(dict(
+                ln1=(w(blk.ln1.gamma), w(blk.ln1.beta)),
+                qkv=(w(blk.attn.qkv.weight), w(blk.attn.qkv.bias)),
+                proj=(w(blk.attn.proj.weight), w(blk.attn.proj.bias)),
+                ln2=(w(blk.ln2.gamma), w(blk.ln2.beta)),
+                up=(w(blk.up.weight), w(blk.up.bias)),
+                down=(w(blk.down.weight), w(blk.down.bias))))
+        return dict(embed=w(self.embed.weight), pos=w(self.pos.weight),
+                    ln_f=(w(self.ln_f.gamma), w(self.ln_f.beta)),
+                    head=w(self.head.weight), layers=layers)
+
+    def _build_decode(self, b, p, max_new, sample):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        d, h = self._d, self.n_heads
+        dh = d // h
+        total = p + max_new
+        scale = math.sqrt(d)
+
+        def ln(x, gb):
+            mu = jnp.mean(x, -1, keepdims=True)
+            var = jnp.mean((x - mu) ** 2, -1, keepdims=True)
+            return (x - mu) / jnp.sqrt(var + 1e-5) * gb[0] + gb[1]
+
+        def decode(wts, prompt, temp, rng):
+            toks = jnp.zeros((b, total), jnp.int32)
+            toks = toks.at[:, :p].set(prompt)
+            caches = [
+                (jnp.zeros((b, h, total, dh), jnp.float32),
+                 jnp.zeros((b, h, total, dh), jnp.float32))
+                for _ in wts["layers"]]
+
+            def step(carry, i):
+                toks, caches, rng = carry
+                tok = toks[:, i]                       # (B,)
+                x = wts["embed"][tok] * scale + wts["pos"][i]
+                new_caches = []
+                for lw, (kc, vc) in zip(wts["layers"], caches):
+                    xa = ln(x, lw["ln1"])
+                    qkv = xa @ lw["qkv"][0].T + lw["qkv"][1]
+                    q, k, v = jnp.split(qkv, 3, axis=-1)
+                    q = q.reshape(b, h, dh)
+                    kc = lax.dynamic_update_index_in_dim(
+                        kc, k.reshape(b, h, dh), i, axis=2)
+                    vc = lax.dynamic_update_index_in_dim(
+                        vc, v.reshape(b, h, dh), i, axis=2)
+                    s = jnp.einsum("bhd,bhcd->bhc", q, kc) \
+                        / math.sqrt(dh)
+                    s = jnp.where(jnp.arange(total)[None, None] <= i,
+                                  s, -1e9)
+                    att = jax.nn.softmax(s, axis=-1)
+                    o = jnp.einsum("bhc,bhcd->bhd", att, vc)
+                    x = x + o.reshape(b, d) @ lw["proj"][0].T \
+                        + lw["proj"][1]
+                    xm = ln(x, lw["ln2"])
+                    hmid = jax.nn.relu(
+                        xm @ lw["up"][0].T + lw["up"][1])
+                    x = x + hmid @ lw["down"][0].T + lw["down"][1]
+                    new_caches.append((kc, vc))
+                logits = ln(x, wts["ln_f"]) @ wts["head"].T
+                if sample:
+                    rng, sub = jax.random.split(rng)
+                    nxt = jax.random.categorical(sub, logits / temp)
+                else:
+                    nxt = jnp.argmax(logits, axis=-1)
+                nxt = nxt.astype(jnp.int32)
+                # teacher-force through the prompt, write after it
+                # (the scan stops at total-2, so i+1 is always valid)
+                cur = lax.dynamic_index_in_dim(toks, i + 1, axis=1,
+                                               keepdims=False)
+                toks = lax.dynamic_update_index_in_dim(
+                    toks, jnp.where(i + 1 >= p, nxt, cur), i + 1,
+                    axis=1)
+                return (toks, new_caches, rng), None
+
+            (toks, _, _), _ = lax.scan(
+                step, (toks, caches, rng), jnp.arange(total - 1))
+            return toks
+
+        return decode
 
     def train_flops_per_token(self, seq_len):
         """Deterministic matmul-FLOPs per token for one fwd+bwd step

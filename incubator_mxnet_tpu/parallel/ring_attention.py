@@ -82,6 +82,13 @@ def ring_attention(q, k, v, mesh, causal=False, scale=None,
         batch_axis = None  # batch too small to split: replicate
     spec = P(batch_axis, seq_axis, None, None)
 
+    if not isinstance(q, jax.core.Tracer):
+        # eager call: concrete arrays may be committed to a single
+        # device, which conflicts with shard_map's mesh — lay them
+        # out over the mesh first (a no-op under jit tracing)
+        sh = jax.sharding.NamedSharding(mesh, spec)
+        q, k, v = (jax.device_put(t, sh) for t in (q, k, v))
+
     @functools.partial(jax.shard_map, mesh=mesh,
                        in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
