@@ -166,12 +166,16 @@ class TransformerLM(Block):
         return self.head(self.ln_f(x))
 
     # ------------------------------------------------------------ decode
+    _GEN_CACHE_MAX = 16   # compiled decode executables kept (FIFO)
+
     def generate(self, tokens, max_new_tokens, temperature=0.0,
                  rng=None):
         """Autoregressive decode with a KV cache, TPU-native: ONE
-        ``lax.scan`` over positions (teacher-forced through the
-        prompt, then sampling), static shapes throughout, compiled
-        once per (batch, prompt_len, max_new_tokens) signature.
+        batched prefill forward seeds the cache for the whole prompt,
+        then ONE ``lax.scan`` emits the new tokens.  Static shapes
+        throughout; compiled once per (batch, prompt_len,
+        max_new_tokens) signature (bounded FIFO of executables — pad
+        prompts to a few fixed lengths to maximise compile reuse).
 
         tokens : (B, P) int NDArray/numpy prompt
         temperature : 0 -> greedy argmax, >0 -> categorical sample
@@ -179,7 +183,6 @@ class TransformerLM(Block):
         """
         import jax
         import jax.numpy as jnp
-        from jax import lax
 
         toks_np = np.asarray(
             tokens.asnumpy() if hasattr(tokens, "asnumpy")
@@ -191,9 +194,10 @@ class TransformerLM(Block):
                 f"prompt+new = {total} exceeds max_len "
                 f"{self._max_len}")
 
+        from ..parameter import DeferredInitializationError
         try:
             wts = self._decode_weights()
-        except Exception:
+        except DeferredInitializationError:
             # deferred-init params (LayerNorm shapes): settle with a
             # tiny probe forward, as functionalize does
             from ... import autograd
@@ -206,6 +210,8 @@ class TransformerLM(Block):
         if cache is None:
             cache = self._gen_cache = {}
         if key not in cache:
+            if len(cache) >= self._GEN_CACHE_MAX:
+                cache.pop(next(iter(cache)))
             cache[key] = jax.jit(self._build_decode(
                 b, p, int(max_new_tokens), temperature > 0))
         fn = cache[key]
@@ -248,17 +254,58 @@ class TransformerLM(Block):
             var = jnp.mean((x - mu) ** 2, -1, keepdims=True)
             return (x - mu) / jnp.sqrt(var + 1e-5) * gb[0] + gb[1]
 
+        def pick(logits, temp, rng):
+            if sample:
+                rng, sub = jax.random.split(rng)
+                nxt = jax.random.categorical(sub, logits / temp)
+            else:
+                nxt = jnp.argmax(logits, axis=-1)
+            return nxt.astype(jnp.int32), rng
+
+        def prefill(wts, prompt):
+            """Batched forward over the whole prompt: seeds the KV
+            caches in one pass and returns the last position's
+            logits (same math as the per-token step)."""
+            x = wts["embed"][prompt] * scale \
+                + wts["pos"][jnp.arange(p)]            # (B, P, D)
+            mask = jnp.tril(jnp.ones((p, p), bool))
+            caches = []
+            for lw in wts["layers"]:
+                xa = ln(x, lw["ln1"])
+                qkv = xa @ lw["qkv"][0].T + lw["qkv"][1]
+                q, k, v = jnp.split(qkv, 3, axis=-1)
+                q = q.reshape(b, p, h, dh).transpose(0, 2, 1, 3)
+                k = k.reshape(b, p, h, dh).transpose(0, 2, 1, 3)
+                v = v.reshape(b, p, h, dh).transpose(0, 2, 1, 3)
+                kc = jnp.zeros((b, h, total, dh),
+                               jnp.float32).at[:, :, :p].set(k)
+                vc = jnp.zeros((b, h, total, dh),
+                               jnp.float32).at[:, :, :p].set(v)
+                s = jnp.einsum("bhqd,bhkd->bhqk", q, k) \
+                    / math.sqrt(dh)
+                att = jax.nn.softmax(
+                    jnp.where(mask[None, None], s, -1e9), axis=-1)
+                o = jnp.einsum("bhqk,bhkd->bhqd", att, v)
+                o = o.transpose(0, 2, 1, 3).reshape(b, p, d)
+                x = x + o @ lw["proj"][0].T + lw["proj"][1]
+                xm = ln(x, lw["ln2"])
+                hmid = jax.nn.relu(xm @ lw["up"][0].T + lw["up"][1])
+                x = x + hmid @ lw["down"][0].T + lw["down"][1]
+                caches.append((kc, vc))
+            logits = ln(x[:, -1], wts["ln_f"]) @ wts["head"].T
+            return caches, logits
+
         def decode(wts, prompt, temp, rng):
+            caches, logits = prefill(wts, prompt)
+            first, rng = pick(logits, temp, rng)
             toks = jnp.zeros((b, total), jnp.int32)
             toks = toks.at[:, :p].set(prompt)
-            caches = [
-                (jnp.zeros((b, h, total, dh), jnp.float32),
-                 jnp.zeros((b, h, total, dh), jnp.float32))
-                for _ in wts["layers"]]
+            toks = toks.at[:, p].set(first)
 
             def step(carry, i):
                 toks, caches, rng = carry
-                tok = toks[:, i]                       # (B,)
+                tok = lax.dynamic_index_in_dim(toks, i, axis=1,
+                                               keepdims=False)
                 x = wts["embed"][tok] * scale + wts["pos"][i]
                 new_caches = []
                 for lw, (kc, vc) in zip(wts["layers"], caches):
@@ -284,23 +331,17 @@ class TransformerLM(Block):
                     x = x + hmid @ lw["down"][0].T + lw["down"][1]
                     new_caches.append((kc, vc))
                 logits = ln(x, wts["ln_f"]) @ wts["head"].T
-                if sample:
-                    rng, sub = jax.random.split(rng)
-                    nxt = jax.random.categorical(sub, logits / temp)
-                else:
-                    nxt = jnp.argmax(logits, axis=-1)
-                nxt = nxt.astype(jnp.int32)
-                # teacher-force through the prompt, write after it
-                # (the scan stops at total-2, so i+1 is always valid)
-                cur = lax.dynamic_index_in_dim(toks, i + 1, axis=1,
-                                               keepdims=False)
+                nxt, rng = pick(logits, temp, rng)
                 toks = lax.dynamic_update_index_in_dim(
-                    toks, jnp.where(i + 1 >= p, nxt, cur), i + 1,
-                    axis=1)
+                    toks, nxt, i + 1, axis=1)
                 return (toks, new_caches, rng), None
 
-            (toks, _, _), _ = lax.scan(
-                step, (toks, caches, rng), jnp.arange(total - 1))
+            # positions p .. total-2 each consume the token at i and
+            # emit the one at i+1 (the prefill already emitted p)
+            if max_new > 1:
+                (toks, _, _), _ = lax.scan(
+                    step, (toks, caches, rng),
+                    jnp.arange(p, total - 1))
             return toks
 
         return decode

@@ -49,15 +49,20 @@ class Trainer:
         self._fopt = None        # functional optimizer (fused path)
         self._fstate = None
         self._fused_update = None
+        self._mesh = None
         if kvstore == "tpu":
+            # capture the ambient mesh NOW: step() may run outside the
+            # use_mesh() scope, and re-resolving there would replicate
+            # params over a different device set than the gradients
+            from ..parallel import current_mesh, make_mesh
+            self._mesh = current_mesh() or make_mesh()
             # replicate now so the *first* forward on a 'dp'-sharded
             # batch already computes distributed (step() comes later)
             self._replicate_params()
 
     def _replicate_params(self):
-        from ..parallel import current_mesh, make_mesh, replicated
-        mesh = current_mesh() or make_mesh()
-        rep = replicated(mesh)
+        from ..parallel import replicated
+        rep = replicated(self._mesh)
         for p in self._params:
             if p._data is not None:
                 p._data._data = jax.device_put(p._data._data, rep)

@@ -25,7 +25,8 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .functional import PureBlock, functionalize
-from .mesh import current_mesh, make_mesh, shard_batch
+from .mesh import (current_mesh, make_mesh, shard_batch,
+                   use_mesh)
 from . import optim as foptim
 from .sharding import ShardingRules
 
@@ -154,8 +155,13 @@ class ShardedTrainStep:
             self._step = self._build(x, y)
         x = jax.device_put(x, self._input_sharding(x.ndim))
         y = jax.device_put(y, self._input_sharding(y.ndim, True))
-        self.params, self.states, self.opt_state, loss = self._step(
-            self.params, self.states, self.opt_state, x, y, rng)
+        # run (and, on the first call, trace) with this step's mesh
+        # ambient, so mesh-aware blocks (e.g. ring attention) resolve
+        # the step's mesh even when called outside use_mesh()
+        with use_mesh(self.mesh):
+            self.params, self.states, self.opt_state, loss = \
+                self._step(self.params, self.states, self.opt_state,
+                           x, y, rng)
         return loss
 
     step = __call__
@@ -175,7 +181,8 @@ class ShardedTrainStep:
                 return outs
             self._eval = jax.jit(ev)
         x = jax.device_put(x, self._input_sharding(x.ndim))
-        return self._eval(self.params, self.states, x, rng)
+        with use_mesh(self.mesh):
+            return self._eval(self.params, self.states, x, rng)
 
     def write_back(self):
         """Copy mesh values back into the Gluon Parameter objects.

@@ -39,3 +39,38 @@ def test_launch_tears_down_on_worker_crash():
          "time.sleep(600)"],
         capture_output=True, text=True, timeout=60, env=env, cwd=repo)
     assert r.returncode == 3, (r.returncode, r.stderr[-500:])
+
+
+def test_launch_elastic_restart(tmp_path):
+    """--max-restarts relaunches the whole job after a failure; the
+    second attempt (simulating resume-from-checkpoint) succeeds."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    marker = tmp_path / "crashed_once"
+    script = (
+        "import os, sys\n"
+        f"m = {str(marker)!r}\n"
+        "if os.environ['MXTPU_WORKER_RANK'] == '0' "
+        "and not os.path.exists(m):\n"
+        "    open(m, 'w').close()\n"
+        "    sys.exit(9)\n"
+        "print('ATTEMPT', os.environ['MXTPU_RESTART_ATTEMPT'],"
+        " 'rank', os.environ['MXTPU_WORKER_RANK'])\n")
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "launch.py"),
+         "-n", "2", "--max-restarts", "2", "--",
+         sys.executable, "-c", script],
+        capture_output=True, text=True, timeout=120, env=env, cwd=repo)
+    out = r.stdout + r.stderr
+    assert r.returncode == 0, out[-2000:]
+    assert "restarting job (attempt 1/2)" in out, out[-2000:]
+    assert "ATTEMPT 1 rank 0" in out, out[-2000:]
+
+    # without restarts the same failure fails the job
+    os.unlink(marker)
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "launch.py"),
+         "-n", "2", "--", sys.executable, "-c", script],
+        capture_output=True, text=True, timeout=120, env=env, cwd=repo)
+    assert r.returncode == 9

@@ -125,10 +125,9 @@ def test_generate_sampled_and_guard():
         net.generate(prompt, 100)
 
 
-def test_seq_parallel_ring_attention_matches_local():
+def test_seq_parallel_ring_attention_matches_local(tmp_path):
     # seq_parallel=True under a mesh with sp>1 must compute the SAME
     # values as local attention (ring attention is exact)
-    import tempfile, os
     from incubator_mxnet_tpu.parallel import make_mesh, use_mesh
     net_sp = TransformerLM(37, d_model=32, n_layers=2, n_heads=4,
                            max_len=16, seq_parallel=True)
@@ -141,7 +140,7 @@ def test_seq_parallel_ring_attention_matches_local():
                        .randint(0, 37, (2, 8)).astype("int32"))
     ref = net_local(toks).asnumpy()
     # share the exact same weights across both attention impls
-    f = os.path.join(tempfile.mkdtemp(), "w.params")
+    f = str(tmp_path / "w.params")
     net_local.save_params(f)
     net_sp(toks)          # settle deferred shapes before loading
     net_sp.load_params(f)
@@ -205,3 +204,35 @@ def test_seq_parallel_non_divisible_seq_falls_back():
     with use_mesh(make_mesh(dp=2, sp=4)):
         got = net(toks).asnumpy()      # L=6 % sp=4 != 0 -> local
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_sharded_step_traces_with_own_mesh_outside_scope():
+    # first call outside use_mesh() must still trace the ring path
+    # with the step's own mesh ambient (not bake in local attention)
+    from incubator_mxnet_tpu.parallel import make_mesh, use_mesh
+    net = _tiny(seq_parallel=True)
+    mesh = make_mesh(dp=2, sp=4)
+    with use_mesh(mesh):
+        step = parallel.ShardedTrainStep(
+            net, optimizer="sgd",
+            optimizer_params=dict(learning_rate=0.1),
+            loss_fn=_lm_loss, mesh=mesh, seq_axis=1,
+            example_args=[mx.nd.array(np.zeros((2, 8), "int32"))])
+    rs = np.random.RandomState(0)
+    toks = jnp.asarray(rs.randint(0, 37, (2, 8)), jnp.int32)
+    labels = jnp.asarray(rs.randint(0, 37, (2, 8)), jnp.int32)
+    # called OUTSIDE the with-block: ambient mesh is None here
+    ring_calls = []
+    import incubator_mxnet_tpu.gluon.model_zoo.transformer as tf_mod
+    orig = tf_mod.CausalSelfAttention._ring_mesh
+    def spy(self, seq_len):
+        m = orig(self, seq_len)
+        ring_calls.append(m is not None)
+        return m
+    tf_mod.CausalSelfAttention._ring_mesh = spy
+    try:
+        loss = float(step(toks, labels))
+    finally:
+        tf_mod.CausalSelfAttention._ring_mesh = orig
+    assert np.isfinite(loss)
+    assert any(ring_calls), "ring path never engaged during trace"

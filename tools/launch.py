@@ -49,6 +49,13 @@ def main():
                     choices=["local", "ssh", "mpi", "sge", "yarn"])
     ap.add_argument("-H", "--hostfile", default=None,
                     help="hostfile for ssh/mpi modes")
+    ap.add_argument("--max-restarts", type=int, default=0,
+                    help="elastic mode: relaunch the whole job up to "
+                    "N times after a worker failure (workers resume "
+                    "from their last checkpoint; collective training "
+                    "cannot continue around a dead rank, so restart "
+                    "is whole-job, the reference's scheduler-restart "
+                    "model)")
     ap.add_argument("command", nargs=argparse.REMAINDER,
                     help="training command")
     args = ap.parse_args()
@@ -69,39 +76,57 @@ def main():
             print(f"{env} {' '.join(cmd)}")
         return 0
 
-    procs = []
-    try:
-        for r in range(args.num_workers):
-            env = dict(os.environ)
-            env["MXTPU_NUM_WORKERS"] = str(args.num_workers)
-            env["MXTPU_WORKER_RANK"] = str(r)
-            env["MXTPU_COORD_ADDR"] = coord
-            p = subprocess.Popen(cmd, env=env)
-            procs.append(p)
-        # poll all workers: one crashing mid-collective would leave
-        # its peers blocked forever, so the first failure tears the
-        # job down (the reference's ps-lite scheduler dies the same
-        # way when a worker drops)
-        import time
-        rc = 0
-        pending = dict(enumerate(procs))
-        while pending and rc == 0:
-            for r, p in list(pending.items()):
-                code = p.poll()
-                if code is None:
-                    continue
-                del pending[r]
-                if code != 0:
-                    print(f"launch.py: worker {r} exited with "
-                          f"{code}; terminating the job",
-                          file=sys.stderr)
-                    rc = code or 1
-            time.sleep(0.05)
-        return rc
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.send_signal(signal.SIGTERM)
+    import time
+
+    def run_once(coord, attempt):
+        procs = []
+        try:
+            for r in range(args.num_workers):
+                env = dict(os.environ)
+                env["MXTPU_NUM_WORKERS"] = str(args.num_workers)
+                env["MXTPU_WORKER_RANK"] = str(r)
+                env["MXTPU_COORD_ADDR"] = coord
+                env["MXTPU_RESTART_ATTEMPT"] = str(attempt)
+                procs.append(subprocess.Popen(cmd, env=env))
+            # poll all workers: one crashing mid-collective would
+            # leave its peers blocked forever, so the first failure
+            # tears the job down (the reference's ps-lite scheduler
+            # dies the same way when a worker drops)
+            rc = 0
+            pending = dict(enumerate(procs))
+            while pending and rc == 0:
+                for r, p in list(pending.items()):
+                    code = p.poll()
+                    if code is None:
+                        continue
+                    del pending[r]
+                    if code != 0:
+                        print(f"launch.py: worker {r} exited with "
+                              f"{code}; terminating the job",
+                              file=sys.stderr)
+                        rc = code or 1
+                time.sleep(0.05)
+            return rc
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.send_signal(signal.SIGTERM)
+            deadline = time.time() + 10
+            for p in procs:
+                while p.poll() is None and time.time() < deadline:
+                    time.sleep(0.05)
+                if p.poll() is None:
+                    p.kill()
+
+    rc = run_once(coord, 0)
+    for attempt in range(1, args.max_restarts + 1):
+        if rc == 0:
+            break
+        print(f"launch.py: restarting job (attempt {attempt}/"
+              f"{args.max_restarts}); workers should resume from "
+              "their last checkpoint", file=sys.stderr)
+        rc = run_once(f"127.0.0.1:{_free_port()}", attempt)
+    return rc
 
 
 if __name__ == "__main__":
