@@ -93,15 +93,32 @@ class CausalSelfAttention(Block):
                 (0, 2, 1, 3)).reshape(b * h, l, dh)
 
         q, k, v = heads(q), heads(k), heads(v)
-        scores = nd.batch_dot(q, k, transpose_b=True) / math.sqrt(dh)
-        mask = nd.array(np.triu(
-            np.full((l, l), -1e9, np.float32), k=1))
-        scores = nd.broadcast_add(scores, mask.expand_dims(0))
-        att = nd.softmax(scores, axis=-1)
-        out = nd.batch_dot(att, v)                 # (B*H, L, Dh)
+        if self._use_flash():
+            # Pallas online-softmax kernel (ops/flash.py): no L x L
+            # score tensor in HBM; registry op, so the tape and the
+            # compiled paths both differentiate it
+            out = nd._internal._flash_attention(q, k, v, causal=True)
+        else:
+            scores = nd.batch_dot(q, k, transpose_b=True) \
+                / math.sqrt(dh)
+            mask = nd.array(np.triu(
+                np.full((l, l), -1e9, np.float32), k=1))
+            scores = nd.broadcast_add(scores, mask.expand_dims(0))
+            att = nd.softmax(scores, axis=-1)
+            out = nd.batch_dot(att, v)             # (B*H, L, Dh)
         out = out.reshape(b, h, l, dh).transpose(
             (0, 2, 1, 3)).reshape(b, l, d)
         return self.proj(out)
+
+    @staticmethod
+    def _use_flash():
+        import os
+
+        import jax
+        flag = os.environ.get("MXTPU_FLASH", "auto")
+        if flag in ("1", "0"):
+            return flag == "1"
+        return jax.default_backend() == "tpu"
 
 
 class TransformerBlock(Block):

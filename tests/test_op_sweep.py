@@ -86,6 +86,9 @@ SPECS = {
     # ---- matmul / linalg
     "dot": dict(inputs=[P(2, 3), P(3, 4)]),
     "batch_dot": dict(inputs=[P(2, 2, 3), P(2, 3, 2)]),
+    "_flash_attention": dict(
+        inputs=[P(2, 4, 3), P(2, 4, 3), P(2, 4, 3)],
+        params=dict(causal=True, interpret=True)),
     "khatri_rao": dict(inputs=[P(2, 3), P(4, 3)]),
     "linalg_gemm": dict(inputs=[P(2, 3), P(3, 4), P(2, 4)]),
     "linalg_gemm2": dict(inputs=[P(2, 3), P(3, 4)]),
