@@ -6,10 +6,16 @@ from .image import (imdecode, imresize, resize_short, fixed_crop,
                     ColorNormalizeAug, BrightnessJitterAug,
                     CreateAugmenter, ImageIter)
 from .record_iter import ImageRecordIter
+from .detection import (ImageDetIter, CreateDetAugmenter,
+                        DetBorrowAug, DetHorizontalFlipAug,
+                        DetRandomCropAug, DetRandomPadAug)
 
 __all__ = ["imdecode", "imresize", "resize_short", "fixed_crop",
            "center_crop", "random_crop", "color_normalize",
            "Augmenter", "ResizeAug", "ForceResizeAug", "CastAug",
            "HorizontalFlipAug", "RandomCropAug", "CenterCropAug",
            "ColorNormalizeAug", "BrightnessJitterAug",
-           "CreateAugmenter", "ImageIter", "ImageRecordIter"]
+           "CreateAugmenter", "ImageIter", "ImageRecordIter",
+           "ImageDetIter", "CreateDetAugmenter", "DetBorrowAug",
+           "DetHorizontalFlipAug", "DetRandomCropAug",
+           "DetRandomPadAug"]

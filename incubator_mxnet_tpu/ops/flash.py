@@ -73,7 +73,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, bq, bk, nk, causal,
             p, vb, preferred_element_type=jnp.float32)
         return m_new, l, acc
 
-    m, l, acc = lax.fori_loop(0, nk, body, (m, l, acc))
+    if causal:
+        # key tiles entirely above the diagonal contribute nothing:
+        # bound the loop at the last tile any of this query tile's
+        # rows can see (~halves the causal FLOPs)
+        upper = jnp.minimum(nk, ((iq + 1) * bq + bk - 1) // bk)
+    else:
+        upper = nk
+    m, l, acc = lax.fori_loop(0, upper, body, (m, l, acc))
     o_ref[0] = (acc / l).astype(o_ref.dtype)
 
 

@@ -1,0 +1,115 @@
+"""ImageDetIter + detection augmenters (ref:
+python/mxnet/image/detection.py ImageDetIter:624, DetAug* family)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import recordio as rio
+from incubator_mxnet_tpu.image.detection import (
+    DetHorizontalFlipAug, DetRandomCropAug, DetRandomPadAug,
+    _parse_det_label)
+
+
+def _make_det_rec(td, n=10):
+    """Pack a synthetic detection dataset: colored boxes on noise."""
+    rs = np.random.RandomState(0)
+    prefix = os.path.join(td, "det")
+    rec = rio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    for i in range(n):
+        img = (rs.rand(60, 80, 3) * 255).astype(np.uint8)
+        nobj = 1 + i % 3
+        objs = []
+        for j in range(nobj):
+            x0, y0 = rs.uniform(0, 0.5, 2)
+            objs += [float(j % 4), x0, y0, x0 + 0.3, y0 + 0.3]
+        label = [2.0, 5.0] + objs          # header_width=2, obj_w=5
+        header = rio.IRHeader(0, label, i, 0)
+        rec.write_idx(i, rio.pack_img(header, img, quality=90))
+    rec.close()
+    return prefix
+
+
+def test_parse_det_label():
+    raw = [2, 5, 1, 0.1, 0.2, 0.4, 0.5]
+    objs = _parse_det_label(raw)
+    assert objs.shape == (1, 5)
+    np.testing.assert_allclose(objs[0], [1, 0.1, 0.2, 0.4, 0.5])
+    with pytest.raises(ValueError):
+        _parse_det_label([2, 5, 1, 0.1])   # body not divisible
+
+
+def test_image_det_iter_batches():
+    with tempfile.TemporaryDirectory() as td:
+        prefix = _make_det_rec(td)
+        it = mx.image.ImageDetIter(
+            batch_size=4, data_shape=(3, 32, 32),
+            path_imgrec=prefix + ".rec", shuffle=True)
+        total = 0
+        for batch in it:
+            data, label = batch.data[0], batch.label[0]
+            assert data.shape == (4, 3, 32, 32)
+            assert label.shape[0] == 4 and label.shape[2] == 5
+            lab = label.asnumpy()
+            valid = lab[lab[:, :, 0] >= 0]
+            assert valid.size > 0
+            assert (valid[:, 1:] >= 0).all() and \
+                (valid[:, 1:] <= 1).all()
+            total += 4 - batch.pad
+        assert total == 10
+        it.reset()
+        assert sum(4 - b.pad for b in it) == 10
+
+
+def test_det_flip_moves_boxes():
+    img = np.zeros((10, 20, 3), np.uint8)
+    label = np.array([[0, 0.1, 0.2, 0.3, 0.6]], np.float32)
+    aug = DetHorizontalFlipAug(p=1.1)     # always flip
+    _, flipped = aug(img, label)
+    np.testing.assert_allclose(flipped[0], [0, 0.7, 0.2, 0.9, 0.6],
+                               rtol=1e-6)
+
+
+def test_det_crop_keeps_normalized_boxes():
+    rs = np.random.RandomState(0)
+    img = (rs.rand(64, 64, 3) * 255).astype(np.uint8)
+    label = np.array([[1, 0.3, 0.3, 0.7, 0.7]], np.float32)
+    aug = DetRandomCropAug(min_object_covered=0.5,
+                           area_range=(0.5, 1.0))
+    for _ in range(10):
+        im2, lab2 = aug(img, label)
+        assert lab2.shape[1] == 5
+        assert (lab2[:, 1:] >= 0).all() and (lab2[:, 1:] <= 1).all()
+        assert im2.shape[0] >= 1 and im2.shape[1] >= 1
+
+
+def test_det_pad_shrinks_boxes():
+    img = np.full((10, 10, 3), 255, np.uint8)
+    label = np.array([[0, 0.0, 0.0, 1.0, 1.0]], np.float32)
+    aug = DetRandomPadAug(area_range=(4.0, 4.0), p=1.1)
+    im2, lab2 = aug(img, label)
+    assert im2.shape[0] == 20 and im2.shape[1] == 20
+    w = lab2[0, 3] - lab2[0, 1]
+    np.testing.assert_allclose(w, 0.5, rtol=1e-6)
+
+
+def test_det_iter_mixed_obj_width_raises():
+    with tempfile.TemporaryDirectory() as td:
+        rs = np.random.RandomState(0)
+        prefix = os.path.join(td, "mix")
+        rec = rio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec",
+                                    "w")
+        img = (rs.rand(16, 16, 3) * 255).astype(np.uint8)
+        rec.write_idx(0, rio.pack_img(
+            rio.IRHeader(0, [2, 5, 0, .1, .1, .4, .4], 0, 0), img))
+        rec.write_idx(1, rio.pack_img(
+            rio.IRHeader(0, [2, 6, 0, .1, .1, .4, .4, 1.0], 1, 0),
+            img))
+        rec.close()
+        it = mx.image.ImageDetIter(batch_size=2,
+                                   data_shape=(3, 16, 16),
+                                   path_imgrec=prefix + ".rec")
+        with pytest.raises(ValueError, match="uniform"):
+            next(iter(it))
