@@ -25,6 +25,9 @@ from .ndarray import NDArray
 from . import random_state
 from . import random
 from . import autograd
+from . import name
+from . import attribute
+from .attribute import AttrScope
 from . import symbol
 from . import symbol as sym
 from .symbol import Symbol

@@ -1,0 +1,55 @@
+"""Attribute scopes (ref: python/mxnet/attribute.py — AttrScope).
+
+``with mx.AttrScope(ctx_group="dev1", lr_mult="0.1"):`` attaches the
+given attributes to every symbol created inside the scope (merged
+over outer scopes, innermost wins) — the reference's mechanism for
+group2ctx placement and per-layer attribute tagging.
+"""
+import threading
+
+__all__ = ["AttrScope", "current_attrs"]
+
+_state = threading.local()
+
+
+def _stack():
+    if not hasattr(_state, "stack"):
+        _state.stack = []
+    return _state.stack
+
+
+class AttrScope:
+    """Scope attaching attributes to symbols created within."""
+
+    def __init__(self, **kwargs):
+        for v in kwargs.values():
+            if not isinstance(v, str):
+                raise ValueError(
+                    "AttrScope values must be strings "
+                    f"(got {type(v).__name__})")
+        self._attr = kwargs
+
+    def get(self, attr=None):
+        """Merge this scope's attrs over ``attr`` (explicit wins)."""
+        out = dict(self._attr)
+        if attr:
+            out.update(attr)
+        return out
+
+    def __enter__(self):
+        _stack().append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _stack().pop()
+
+
+def current_attrs(attr=None):
+    """Attributes from every active scope (outer -> inner), with the
+    explicit ``attr`` dict winning over all scopes."""
+    out = {}
+    for scope in _stack():
+        out.update(scope._attr)
+    if attr:
+        out.update(attr)
+    return out

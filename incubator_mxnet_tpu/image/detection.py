@@ -175,9 +175,8 @@ class _DetResizeAug:
         self.height = height
 
     def __call__(self, img, label):
-        img = np.asarray(imresize(nd_array(img), self.width,
-                                  self.height))
-        return img, label
+        return np.asarray(imresize(img, self.width, self.height)), \
+            label
 
 
 def CreateDetAugmenter(data_shape, resize=True, rand_crop=0.0,
@@ -233,38 +232,17 @@ class ImageDetIter(ImageIter):
             label_name,
             (batch_size, self._max_objs, self._obj_width))]
 
-    def _next_label(self):
-        """Label of the next sample WITHOUT decoding the image (the
-        estimation scan needs only headers)."""
-        from .. import recordio as rio
-        if self._recordio is not None:
-            if self._seq is not None:
-                if self._cursor >= len(self._seq):
-                    return None
-                rec = self._recordio.read_idx(
-                    self._seq[self._cursor])
-            else:
-                rec = self._recordio.read()
-                if rec is None:
-                    return None
-            self._cursor += 1
-            header, _ = rio.unpack(rec)
-            return header.label
-        if self._cursor >= len(self._seq):
-            return None
-        _, labels = self._imglist[self._seq[self._cursor]]
-        self._cursor += 1
-        return np.asarray(labels, np.float32)
-
     def _estimate_label_shape(self, max_objects):
-        """Scan up to 100 samples for (max objects, obj width)
+        """Scan the WHOLE dataset's labels (no image decode) for
+        (max objects, obj width) — a partial window would make a
+        crowded late sample overflow the padded label mid-epoch
         (ref: detection.py _estimate_label_shape)."""
         max_objs, obj_width = 1, 5
-        for _ in range(100):
-            raw = self._next_label()
-            if raw is None:
+        while True:
+            sample = self._next_sample(decode=False)
+            if sample is None:
                 break
-            objs = _parse_det_label(raw)
+            objs = _parse_det_label(sample[0])
             max_objs = max(max_objs, objs.shape[0])
             obj_width = max(obj_width, objs.shape[1])
         self.reset()
@@ -289,7 +267,7 @@ class ImageDetIter(ImageIter):
             for aug in self.det_auglist:
                 img, objs = aug(img, objs)
             if img.shape[:2] != (h, w):
-                img = np.asarray(imresize(nd_array(img), w, h))
+                img = np.asarray(imresize(img, w, h))
             img = img.astype(np.float32)
             batch_data[i] = np.transpose(np.atleast_3d(img),
                                          (2, 0, 1))[:c]

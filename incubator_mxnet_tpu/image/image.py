@@ -290,7 +290,10 @@ class ImageIter(DataIter):
         if self._recordio is not None and self._seq is None:
             self._recordio.reset()
 
-    def _next_sample(self):
+    def _next_sample(self, decode=True):
+        """Next (label, image) pair; ``decode=False`` skips the image
+        entirely (label-only scans, e.g. detection shape
+        estimation)."""
         from .. import recordio as rio
         if self._recordio is not None:
             if self._seq is not None:
@@ -304,11 +307,13 @@ class ImageIter(DataIter):
             self._cursor += 1
             header, img_bytes = rio.unpack(rec)
             label = header.label
-            return label, imdecode(img_bytes)
+            return label, imdecode(img_bytes) if decode else None
         if self._cursor >= len(self._seq):
             return None
         path, labels = self._imglist[self._seq[self._cursor]]
         self._cursor += 1
+        if not decode:
+            return np.asarray(labels, np.float32), None
         with open(path, "rb") as f:
             return np.asarray(labels, np.float32), imdecode(f.read())
 

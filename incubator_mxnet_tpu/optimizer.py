@@ -52,10 +52,17 @@ class Optimizer:
         if sym is not None:
             attrs = sym.attr_dict()
             for name, a in attrs.items():
-                if "__lr_mult__" in a:
-                    self.lr_mult[name] = float(a["__lr_mult__"])
-                if "__wd_mult__" in a:
-                    self.wd_mult[name] = float(a["__wd_mult__"])
+                # both spellings count: Variable kwargs store the
+                # dunder form (__lr_mult__), AttrScope stores the
+                # plain key (lr_mult) verbatim
+                for key in ("__lr_mult__", "lr_mult"):
+                    if key in a:
+                        self.lr_mult[name] = float(a[key])
+                        break
+                for key in ("__wd_mult__", "wd_mult"):
+                    if key in a:
+                        self.wd_mult[name] = float(a[key])
+                        break
 
     # -- state ------------------------------------------------------------
     def create_state(self, index, weight):

@@ -21,13 +21,19 @@ __all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
 
 
 class NameManager:
-    """Auto-naming for anonymous ops (ref: python/mxnet/name.py)."""
+    """Auto-naming for anonymous ops (ref: python/mxnet/name.py).
+    An active ``mx.name.NameManager``/``Prefix`` scope overrides the
+    process-global counters."""
 
     _lock = threading.Lock()
     _counters = {}
 
     @classmethod
     def next_name(cls, prefix):
+        from .. import name as name_mod
+        mgr = name_mod.current()
+        if mgr is not None:
+            return mgr.get(None, prefix)
         prefix = prefix.lower().lstrip("_")
         with cls._lock:
             idx = cls._counters.get(prefix, 0)
@@ -405,7 +411,9 @@ def _invoke(op, sym_args, params, name=None):
     auto-created fc1_weight etc.).  ``None`` entries in sym_args are
     interior gaps (input given by keyword with an earlier slot
     omitted) and are auto-created in place."""
+    from ..attribute import current_attrs
     name = name or NameManager.next_name(op.name)
+    scope_attrs = current_attrs()
     inputs = [None if s is None else s._entry() for s in sym_args]
     if not op.variadic:
         needed = list(op.arg_names) + list(op.aux_names)
@@ -418,7 +426,12 @@ def _invoke(op, sym_args, params, name=None):
             if given is None:
                 if argname == "bias" and no_bias:
                     continue
-                attrs = {"__is_aux__": "1"} if is_aux else {}
+                # auto-created weights inherit the active AttrScope
+                # (so e.g. lr_mult set at layer scope reaches the
+                # parameter the optimizer reads it from)
+                attrs = dict(scope_attrs)
+                if is_aux:
+                    attrs["__is_aux__"] = "1"
                 filled.append(
                     (_Node(None, f"{name}_{argname}", attrs=attrs), 0))
             else:
@@ -429,14 +442,16 @@ def _invoke(op, sym_args, params, name=None):
                 filled.append(given)
         filled.extend(inputs[len(needed):])   # over-provided: keep
         inputs = filled
-    node = _Node(op, name, inputs, params)
+    node = _Node(op, name, inputs, params,
+                 attrs=scope_attrs or None)
     return Symbol([(node, i) for i in range(node.n_outputs())]
                   if node.n_outputs() > 1 else [(node, 0)])
 
 
 def Variable(name, attr=None, shape=None, dtype=None, init=None, **kwargs):
     """Create a variable symbol (ref: symbol.py var)."""
-    attrs = dict(attr or {})
+    from ..attribute import current_attrs
+    attrs = current_attrs(attr)
     if shape is not None:
         attrs["__shape__"] = str(tuple(shape))
     if dtype is not None:

@@ -1,0 +1,86 @@
+"""Name/attribute scopes (ref: python/mxnet/name.py NameManager/
+Prefix, python/mxnet/attribute.py AttrScope)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import sym
+
+
+def test_prefix_scope_names():
+    data = sym.Variable("data")
+    with mx.name.Prefix("stage1_"):
+        fc = sym.FullyConnected(data, num_hidden=4)
+    assert fc.list_outputs()[0].startswith("stage1_fullyconnected")
+    args = fc.list_arguments()
+    assert any(a.startswith("stage1_") and a.endswith("_weight")
+               for a in args), args
+
+
+def test_name_manager_scope_restarts_counters():
+    data = sym.Variable("data")
+    with mx.name.NameManager():
+        a = sym.Activation(data, act_type="relu")
+        b = sym.Activation(data, act_type="relu")
+    assert a.list_outputs()[0].startswith("activation0")
+    assert b.list_outputs()[0].startswith("activation1")
+    with mx.name.NameManager():      # fresh scope, fresh counters
+        c = sym.Activation(data, act_type="relu")
+    assert c.list_outputs()[0].startswith("activation0")
+
+
+def test_attr_scope_tags_symbols():
+    with mx.AttrScope(ctx_group="dev1", lr_mult="0.1"):
+        v = sym.Variable("w")
+        fc = sym.FullyConnected(v, num_hidden=2, name="fc")
+    assert v.attr("ctx_group") == "dev1"
+    assert v.attr("lr_mult") == "0.1"
+    assert fc.attr("ctx_group") == "dev1"
+    # nesting: inner scope wins, outer restored on exit
+    with mx.AttrScope(ctx_group="a"):
+        with mx.AttrScope(ctx_group="b"):
+            u = sym.Variable("u")
+        w2 = sym.Variable("w2")
+    assert u.attr("ctx_group") == "b"
+    assert w2.attr("ctx_group") == "a"
+    # explicit attr beats the scope
+    with mx.AttrScope(ctx_group="a"):
+        z = sym.Variable("z", attr={"ctx_group": "explicit"})
+    assert z.attr("ctx_group") == "explicit"
+
+
+def test_attr_scope_rejects_non_string():
+    with pytest.raises(ValueError):
+        mx.AttrScope(lr_mult=0.1)
+
+
+def test_attr_scope_json_roundtrip(tmp_path):
+    with mx.AttrScope(ctx_group="dev2"):
+        data = sym.Variable("data")
+        net = sym.FullyConnected(data, num_hidden=2, name="fc")
+    s2 = sym.load_json(net.tojson())
+    assert s2.attr("ctx_group") == "dev2"
+
+
+def test_executor_works_under_scopes():
+    with mx.name.Prefix("p_"), mx.AttrScope(tag="x"):
+        data = sym.Variable("data")
+        net = sym.FullyConnected(data, num_hidden=3)
+    ex = net.simple_bind(mx.cpu(), data=(2, 4))
+    out = ex.forward()[0]
+    assert out.shape == (2, 3)
+
+
+def test_attr_scope_lr_mult_reaches_optimizer():
+    from incubator_mxnet_tpu.optimizer import SGD
+    with mx.AttrScope(lr_mult="0.1", wd_mult="0.5"):
+        data = sym.Variable("data")
+        net = sym.FullyConnected(data, num_hidden=3, name="fc")
+    opt = SGD(sym=net)
+    assert opt.lr_mult.get("fc_weight") == 0.1, opt.lr_mult
+    assert opt.wd_mult.get("fc_weight") == 0.5, opt.wd_mult
+    # the dunder spelling via Variable kwargs still works
+    v = sym.Variable("w", lr_mult=0.2)
+    opt2 = SGD(sym=sym.FullyConnected(
+        sym.Variable("d"), weight=v, num_hidden=2, name="g"))
+    assert opt2.lr_mult.get("w") == 0.2, opt2.lr_mult
