@@ -1,9 +1,12 @@
 """Attribute scopes (ref: python/mxnet/attribute.py — AttrScope).
 
-``with mx.AttrScope(ctx_group="dev1", lr_mult="0.1"):`` attaches the
-given attributes to every symbol created inside the scope (merged
-over outer scopes, innermost wins) — the reference's mechanism for
-group2ctx placement and per-layer attribute tagging.
+``with mx.AttrScope(ctx_group="dev1", __lr_mult__="0.1"):`` attaches
+the given attributes to every symbol created inside the scope —
+including auto-created weight variables — merged over outer scopes
+with the innermost winning: the reference's mechanism for group2ctx
+placement and per-layer tagging (the optimizer reads the dunder
+``__lr_mult__``/``__wd_mult__`` spellings, same as it does for
+``Variable(lr_mult=...)``).
 """
 import threading
 
@@ -28,13 +31,6 @@ class AttrScope:
                     "AttrScope values must be strings "
                     f"(got {type(v).__name__})")
         self._attr = kwargs
-
-    def get(self, attr=None):
-        """Merge this scope's attrs over ``attr`` (explicit wins)."""
-        out = dict(self._attr)
-        if attr:
-            out.update(attr)
-        return out
 
     def __enter__(self):
         _stack().append(self)

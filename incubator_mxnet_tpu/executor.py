@@ -318,6 +318,14 @@ class Executor:
         (ref: MXExecutorSimpleBind, c_api_executor.cc:220)."""
         arg_shapes, _, aux_shapes = symbol.infer_shape(**shape_kwargs)
         arg_names = symbol.list_arguments()
+        if len(set(arg_names)) != len(arg_names):
+            dupes = sorted({n for n in arg_names
+                            if arg_names.count(n) > 1})
+            raise ValueError(
+                f"duplicate argument names {dupes}: distinct "
+                "variables share a name (a scoped NameManager can "
+                "restart counters mid-graph) — disambiguate with "
+                "name=/mx.name.Prefix scopes")
         aux_names = symbol.list_auxiliary_states()
         type_dict = type_dict or {}
         args = {}

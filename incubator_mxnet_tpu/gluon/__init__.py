@@ -8,6 +8,7 @@ from . import data
 from . import utils
 from . import rnn
 from . import model_zoo
+from . import contrib
 
 __all__ = ["Parameter", "ParameterDict", "Block", "HybridBlock",
            "SymbolBlock", "Trainer", "nn", "loss", "data", "utils",

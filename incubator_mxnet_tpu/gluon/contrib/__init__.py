@@ -1,0 +1,4 @@
+"""Contrib gluon APIs (ref: python/mxnet/gluon/contrib/)."""
+from . import rnn
+
+__all__ = ["rnn"]

@@ -50,19 +50,16 @@ class Optimizer:
         self.lr_mult = {}
         self.wd_mult = {}
         if sym is not None:
+            # only the dunder spellings count (ref: optimizer.py
+            # set_lr_mult:298 reads '__lr_mult__'); scope users write
+            # AttrScope(__lr_mult__=...), Variable(lr_mult=...) is
+            # dunder-wrapped by the Variable kwargs path
             attrs = sym.attr_dict()
             for name, a in attrs.items():
-                # both spellings count: Variable kwargs store the
-                # dunder form (__lr_mult__), AttrScope stores the
-                # plain key (lr_mult) verbatim
-                for key in ("__lr_mult__", "lr_mult"):
-                    if key in a:
-                        self.lr_mult[name] = float(a[key])
-                        break
-                for key in ("__wd_mult__", "wd_mult"):
-                    if key in a:
-                        self.wd_mult[name] = float(a[key])
-                        break
+                if "__lr_mult__" in a:
+                    self.lr_mult[name] = float(a["__lr_mult__"])
+                if "__wd_mult__" in a:
+                    self.wd_mult[name] = float(a["__wd_mult__"])
 
     # -- state ------------------------------------------------------------
     def create_state(self, index, weight):

@@ -1,0 +1,97 @@
+"""gluon.contrib cells (ref: python/mxnet/gluon/contrib/rnn/)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+from incubator_mxnet_tpu.gluon.contrib.rnn import (
+    Conv1DLSTMCell, Conv2DGRUCell, Conv2DLSTMCell, Conv2DRNNCell,
+    VariationalDropoutCell)
+
+
+def _step(cell, shape):
+    cell.initialize(mx.initializer.Xavier())
+    x = nd.array(np.random.RandomState(0).rand(*shape)
+                 .astype("float32"))
+    states = cell.begin_state(batch_size=shape[0])
+    out, new_states = cell(x, states)
+    return out, new_states
+
+
+def test_conv2d_rnn_cell():
+    cell = Conv2DRNNCell(input_shape=(3, 8, 8), hidden_channels=5,
+                         i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    out, states = _step(cell, (2, 3, 8, 8))
+    assert out.shape == (2, 5, 8, 8)
+    assert len(states) == 1 and states[0].shape == (2, 5, 8, 8)
+
+
+def test_conv2d_lstm_cell_and_unroll():
+    cell = Conv2DLSTMCell(input_shape=(2, 6, 6), hidden_channels=4,
+                          i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    out, states = _step(cell, (2, 2, 6, 6))
+    assert out.shape == (2, 4, 6, 6)
+    assert len(states) == 2             # h and c
+    # unroll over a sequence
+    seq = [nd.array(np.random.RandomState(i).rand(2, 2, 6, 6)
+                    .astype("float32")) for i in range(3)]
+    outs, final = cell.unroll(3, seq, merge_outputs=False)
+    assert len(outs) == 3
+    assert outs[-1].shape == (2, 4, 6, 6)
+
+
+def test_conv2d_gru_cell():
+    cell = Conv2DGRUCell(input_shape=(3, 5, 5), hidden_channels=6,
+                         i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    out, states = _step(cell, (1, 3, 5, 5))
+    assert out.shape == (1, 6, 5, 5)
+
+
+def test_conv1d_lstm_cell():
+    cell = Conv1DLSTMCell(input_shape=(4, 10), hidden_channels=3,
+                          i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    out, states = _step(cell, (2, 4, 10))
+    assert out.shape == (2, 3, 10)
+
+
+def test_even_h2h_kernel_rejected():
+    with pytest.raises(ValueError, match="odd"):
+        Conv2DRNNCell(input_shape=(3, 8, 8), hidden_channels=5,
+                      i2h_kernel=3, h2h_kernel=2)
+
+
+def test_variational_dropout_locked_mask():
+    mx.random.seed(0)
+    base = gluon.rnn.LSTMCell(8)
+    cell = VariationalDropoutCell(base, drop_inputs=0.5,
+                                  drop_outputs=0.5)
+    cell.initialize(mx.initializer.Xavier())
+    x = nd.array(np.ones((2, 8), "float32"))
+    states = cell.begin_state(batch_size=2)
+    with autograd.record():
+        o1, s1 = cell(x, states)
+        o2, _ = cell(x, s1)
+    # same mask across steps: zeroed output channels stay zeroed
+    m1 = (o1.asnumpy() == 0)
+    m2 = (o2.asnumpy() == 0)
+    assert (m1 == m2).all()
+    assert m1.any(), "dropout never dropped anything at p=0.5"
+    cell.reset()
+    # inference mode: no dropout
+    o3, _ = cell(x, cell.begin_state(batch_size=2))
+    assert not np.isnan(o3.asnumpy()).any()
+
+
+def test_variational_dropout_trains():
+    mx.random.seed(1)
+    base = gluon.rnn.GRUCell(4)
+    cell = VariationalDropoutCell(base, drop_states=0.3)
+    cell.initialize(mx.initializer.Xavier())
+    seq = [nd.array(np.random.RandomState(i).rand(2, 4)
+                    .astype("float32")) for i in range(3)]
+    with autograd.record():
+        outs, _ = cell.unroll(3, seq, merge_outputs=False)
+        loss = sum((o * o).sum() for o in outs)
+    loss.backward()
+    g = base.i2h_weight.data().grad
+    assert g is not None and np.isfinite(g.asnumpy()).all()

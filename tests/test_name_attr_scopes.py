@@ -73,7 +73,7 @@ def test_executor_works_under_scopes():
 
 def test_attr_scope_lr_mult_reaches_optimizer():
     from incubator_mxnet_tpu.optimizer import SGD
-    with mx.AttrScope(lr_mult="0.1", wd_mult="0.5"):
+    with mx.AttrScope(__lr_mult__="0.1", __wd_mult__="0.5"):
         data = sym.Variable("data")
         net = sym.FullyConnected(data, num_hidden=3, name="fc")
     opt = SGD(sym=net)
@@ -84,3 +84,12 @@ def test_attr_scope_lr_mult_reaches_optimizer():
     opt2 = SGD(sym=sym.FullyConnected(
         sym.Variable("d"), weight=v, num_hidden=2, name="g"))
     assert opt2.lr_mult.get("w") == 0.2, opt2.lr_mult
+
+
+def test_duplicate_arg_names_rejected_at_bind():
+    data = sym.Variable("data")
+    a = sym.FullyConnected(data, num_hidden=2)
+    with mx.name.NameManager():     # counters restart -> collision
+        b = sym.FullyConnected(a, num_hidden=2)
+    with pytest.raises(ValueError, match="duplicate argument"):
+        b.simple_bind(mx.cpu(), data=(2, 3))
