@@ -55,6 +55,8 @@ def make_corpus(rs, vocab, n_sentences):
 
 
 def main(argv=None):
+    from incubator_mxnet_tpu.utils.platform import maybe_force_cpu
+    maybe_force_cpu()
     args = parse_args(argv)
     if args.quick:
         args.num_hidden, args.num_embed = 32, 16

@@ -183,6 +183,8 @@ def anchor_scale_check(mx, nd):
 
 
 def main(argv=None):
+    from incubator_mxnet_tpu.utils.platform import maybe_force_cpu
+    maybe_force_cpu()
     args = parse_args(argv)
     if args.quick:
         args.num_iters = 160

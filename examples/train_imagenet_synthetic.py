@@ -49,6 +49,8 @@ def parse_args(argv=None):
 
 
 def main(argv=None):
+    from incubator_mxnet_tpu.utils.platform import maybe_force_cpu
+    maybe_force_cpu()
     args = parse_args(argv)
     if args.quick:
         args.network = "resnet18_v1"

@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Character-level transformer LM: train, evaluate, generate.
+
+The model-family workload the reference era predates but a modern
+user expects end-to-end: gluon TransformerLM trained through
+ShardedTrainStep (kvstore='tpu' semantics: dp-sharded batch, in-jit
+AdamW-style update, optional bf16 compute), then KV-cache generation
+from the trained weights.
+
+Corpus is synthetic (zero-egress): sentences from a fixed template
+grammar, so cross-entropy has a learnable floor far below uniform.
+--quick is the CI gate: asserts loss drops below 50% of the first
+step's and that greedy generation reproduces a memorized bigram.
+"""
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+TEXT = ("the quick brown fox jumps over the lazy dog . "
+        "a stitch in time saves nine . "
+        "all that glitters is not gold . ") * 30
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="transformer char-LM")
+    p.add_argument("--d-model", type=int, default=128)
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--seq-len", type=int, default=32)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--steps", type=int, default=200)
+    p.add_argument("--lr", type=float, default=3e-3)
+    p.add_argument("--bf16", action="store_true",
+                   help="bf16 compute with fp32 masters")
+    p.add_argument("--seq-parallel", action="store_true",
+                   help="ring attention over the mesh 'sp' axis")
+    p.add_argument("--quick", action="store_true",
+                   help="small run + convergence gate (CI)")
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    from incubator_mxnet_tpu.utils.platform import maybe_force_cpu
+    maybe_force_cpu()
+    args = parse_args(argv)
+    if args.quick:
+        args.steps = 120
+        args.d_model = 64
+
+    import jax
+    import jax.numpy as jnp
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import parallel
+    from incubator_mxnet_tpu.gluon.model_zoo.transformer import \
+        TransformerLM
+
+    vocab = sorted(set(TEXT))
+    stoi = {c: i for i, c in enumerate(vocab)}
+    data = np.array([stoi[c] for c in TEXT], np.int32)
+
+    mx.random.seed(0)
+    net = TransformerLM(len(vocab), d_model=args.d_model,
+                        n_layers=args.layers, n_heads=args.heads,
+                        max_len=args.seq_len * 2,
+                        seq_parallel=args.seq_parallel)
+    net.initialize(mx.initializer.Xavier())
+
+    def lm_loss(outputs, labels):
+        logits = outputs[0].astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.mean(
+            jnp.take_along_axis(logp, labels[..., None], axis=-1))
+
+    step = parallel.ShardedTrainStep(
+        net, optimizer="adam",
+        optimizer_params=dict(learning_rate=args.lr),
+        loss_fn=lm_loss, seq_axis=1 if args.seq_parallel else None,
+        example_args=[mx.nd.array(
+            np.zeros((2, args.seq_len), "int32"))],
+        compute_dtype=jnp.bfloat16 if args.bf16 else None)
+
+    rs = np.random.RandomState(0)
+    first_loss = last_loss = None
+    for it in range(args.steps):
+        idx = rs.randint(0, len(data) - args.seq_len - 1,
+                         (args.batch_size,))
+        x = np.stack([data[i:i + args.seq_len] for i in idx])
+        y = np.stack([data[i + 1:i + args.seq_len + 1] for i in idx])
+        loss = float(step(jnp.asarray(x), jnp.asarray(y)))
+        if first_loss is None:
+            first_loss = loss
+        last_loss = loss
+        if it % 40 == 0:
+            print(f"step {it}: loss={loss:.4f}", flush=True)
+
+    # pull trained weights back into the Block, then generate
+    step.write_back()
+    prompt = "the quick brown "
+    out = net.generate(
+        mx.nd.array(np.array([[stoi[c] for c in prompt]], np.int32)),
+        max_new_tokens=12)
+    gen = "".join(vocab[t] for t in out.asnumpy()[0])
+    print("generated:", repr(gen))
+
+    summary = dict(first_loss=first_loss, final_loss=last_loss,
+                   generated=gen, vocab=len(vocab),
+                   params=args.d_model)
+    print(json.dumps({k: v for k, v in summary.items()}))
+    if args.quick:
+        assert last_loss < first_loss * 0.5, summary
+        assert gen.startswith(prompt)
+        assert "fox" in gen, summary   # memorized continuation
+    return summary
+
+
+if __name__ == "__main__":
+    main()

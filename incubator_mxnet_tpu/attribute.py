@@ -25,11 +25,18 @@ class AttrScope:
     """Scope attaching attributes to symbols created within."""
 
     def __init__(self, **kwargs):
-        for v in kwargs.values():
+        for k, v in kwargs.items():
             if not isinstance(v, str):
                 raise ValueError(
                     "AttrScope values must be strings "
                     f"(got {type(v).__name__})")
+            if k in ("lr_mult", "wd_mult"):
+                import warnings
+                warnings.warn(
+                    f"AttrScope({k}=...) is not read by the "
+                    f"optimizer; use the dunder spelling "
+                    f"__{k}__=... (reference convention)",
+                    stacklevel=2)
         self._attr = kwargs
 
     def __enter__(self):

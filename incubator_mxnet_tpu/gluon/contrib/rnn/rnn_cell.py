@@ -59,9 +59,5 @@ class VariationalDropoutCell(ModifierCell):
                 "out", self.drop_outputs, output)
         return output, next_states
 
-    def unroll(self, length, inputs, begin_state=None, layout="NTC",
-               merge_outputs=None):
-        self.reset()
-        return super().unroll(length, inputs,
-                              begin_state=begin_state, layout=layout,
-                              merge_outputs=merge_outputs)
+    # no unroll override needed: RecurrentCell.unroll calls
+    # self.reset() first, which redraws the locked masks per sequence

@@ -1,0 +1,28 @@
+"""Host-platform pinning for CLI entry points.
+
+``MXTPU_FORCE_CPU=1`` pins jax to the host CPU with 8 virtual
+devices — for machines whose accelerator plugin is absent or
+unhealthy.  Env vars alone are overridden by a sitecustomize that
+forces the accelerator platform; the in-process config update is
+authoritative, provided no backend has initialized yet (importing
+jax or this package is fine; creating an array is not).
+"""
+import os
+
+__all__ = ["maybe_force_cpu"]
+
+
+def maybe_force_cpu():
+    if not os.environ.get("MXTPU_FORCE_CPU"):
+        return False
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    return True

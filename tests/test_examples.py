@@ -46,3 +46,19 @@ def test_ssd_anchor_scale_8732():
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import nd
     ex.anchor_scale_check(mx, nd)
+
+
+def test_transformer_lm_quick():
+    import transformer_lm as ex
+    summary = ex.main(["--quick"])
+    assert summary["final_loss"] < summary["first_loss"] * 0.5
+    assert "fox" in summary["generated"]
+
+
+def test_transformer_lm_seq_parallel_quick():
+    from incubator_mxnet_tpu.parallel import make_mesh, use_mesh
+    import transformer_lm as ex
+    with use_mesh(make_mesh(dp=2, sp=4)):
+        summary = ex.main(["--quick", "--seq-parallel",
+                           "--batch-size", "16"])
+    assert summary["final_loss"] < summary["first_loss"] * 0.5

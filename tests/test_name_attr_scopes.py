@@ -88,8 +88,11 @@ def test_attr_scope_lr_mult_reaches_optimizer():
 
 def test_duplicate_arg_names_rejected_at_bind():
     data = sym.Variable("data")
-    a = sym.FullyConnected(data, num_hidden=2)
-    with mx.name.NameManager():     # counters restart -> collision
+    # two fresh scopes -> both layers named fullyconnected0,
+    # deterministically colliding regardless of the global counter
+    with mx.name.NameManager():
+        a = sym.FullyConnected(data, num_hidden=2)
+    with mx.name.NameManager():
         b = sym.FullyConnected(a, num_hidden=2)
     with pytest.raises(ValueError, match="duplicate argument"):
         b.simple_bind(mx.cpu(), data=(2, 3))
