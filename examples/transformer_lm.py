@@ -111,7 +111,7 @@ def main(argv=None):
     summary = dict(first_loss=first_loss, final_loss=last_loss,
                    generated=gen, vocab=len(vocab),
                    params=args.d_model)
-    print(json.dumps({k: v for k, v in summary.items()}))
+    print(json.dumps(summary))
     if args.quick:
         assert last_loss < first_loss * 0.5, summary
         assert gen.startswith(prompt)

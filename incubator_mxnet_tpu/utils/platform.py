@@ -8,12 +8,17 @@ authoritative, provided no backend has initialized yet (importing
 jax or this package is fine; creating an array is not).
 """
 import os
+import warnings
 
 __all__ = ["maybe_force_cpu"]
 
 
 def maybe_force_cpu():
-    if not os.environ.get("MXTPU_FORCE_CPU"):
+    """Returns True iff the CPU pin is in effect.  '0'/'false'/unset
+    disable it; a warning is issued when pinning is requested but can
+    no longer take effect (a backend already initialized)."""
+    flag = os.environ.get("MXTPU_FORCE_CPU", "").lower()
+    if flag in ("", "0", "false", "no"):
         return False
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
@@ -22,7 +27,17 @@ def maybe_force_cpu():
         ).strip()
     import jax
     try:
-        jax.config.update("jax_platforms", "cpu")
-    except Exception:
+        from jax._src import xla_bridge as _xb
+        if _xb.backends_are_initialized():
+            if any(p != "cpu" for p in _xb._backends):
+                warnings.warn(
+                    "MXTPU_FORCE_CPU set, but an accelerator backend "
+                    "already initialized — the CPU pin cannot take "
+                    "effect; call maybe_force_cpu() before any device "
+                    "op", stacklevel=2)
+                return False
+            return True
+    except ImportError:
         pass
+    jax.config.update("jax_platforms", "cpu")
     return True

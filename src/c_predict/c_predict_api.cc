@@ -29,16 +29,14 @@ import tempfile
 
 import numpy as np
 
-if os.environ.get("MXTPU_FORCE_CPU"):
+try:
     # embedded standalone clients (tests, CI) that must not touch an
-    # accelerator: pin the host platform before the first jax use
-    os.environ.setdefault(
-        "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-    try:
-        import jax
-        jax.config.update("jax_platforms", "cpu")
-    except Exception:
-        pass
+    # accelerator: MXTPU_FORCE_CPU pins the host platform before the
+    # first jax use (one shared implementation with the CLI tools)
+    from incubator_mxnet_tpu.utils.platform import maybe_force_cpu
+    maybe_force_cpu()
+except Exception:
+    pass
 
 
 class _CPred(object):

@@ -1,0 +1,65 @@
+"""Tool scripts (ref: tools/rec2idx.py, tools/parse_log.py)."""
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+from incubator_mxnet_tpu import recordio as rio
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+sys.path.insert(0, TOOLS)
+
+
+def test_rec2idx_roundtrip():
+    with tempfile.TemporaryDirectory() as td:
+        prefix = os.path.join(td, "d")
+        rec = rio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec",
+                                    "w")
+        payloads = {}
+        for i in range(7):
+            buf = os.urandom(10 + i)
+            payloads[i] = buf
+            rec.write_idx(i, rio.pack(
+                rio.IRHeader(0, float(i), i, 0), buf))
+        rec.close()
+        orig = open(prefix + ".idx").read()
+        os.unlink(prefix + ".idx")
+
+        import rec2idx
+        idx_path, n = rec2idx.build_index(prefix + ".rec")
+        assert n == 7
+        assert open(idx_path).read() == orig
+        # random access works through the rebuilt index
+        r = rio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec",
+                                  "r")
+        for i in (3, 0, 6):
+            header, buf = rio.unpack(r.read_idx(i))
+            assert buf == payloads[i]
+            assert int(header.id) == i
+
+
+def test_parse_log():
+    import parse_log
+    log = """\
+INFO Epoch[0] Batch [20]  Speed: 100.0 samples/sec  accuracy=0.5
+INFO Epoch[0] Batch [40]  Speed: 300.0 samples/sec  accuracy=0.6
+INFO Epoch[0] Train-accuracy=0.61
+INFO Epoch[0] Time cost=10.5
+INFO Epoch[0] Validation-accuracy=0.58
+INFO Epoch[1] Batch [20]  Speed: 200.0 samples/sec  accuracy=0.7
+INFO Epoch[1] Train-accuracy=0.72
+INFO Epoch[1] Time cost=9.0
+INFO Epoch[1] Validation-accuracy=0.69
+"""
+    epochs = parse_log.parse(log.splitlines())
+    assert epochs[0]["speed"] == [100.0, 300.0]
+    assert epochs[0]["train"]["accuracy"] == 0.61
+    assert epochs[1]["val"]["accuracy"] == 0.69
+    md = parse_log.render(epochs)
+    assert "train-accuracy" in md and "| 0" in md
+    csv = parse_log.render(epochs, "csv")
+    assert csv.splitlines()[0].startswith("epoch,speed")
+    assert "200" in csv

@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Parse training logs into a table (ref: tools/parse_log.py).
+
+Understands the Speedometer/fit log shapes this framework emits:
+  Epoch[3] Batch [40]  Speed: 1234.56 samples/sec  accuracy=0.912
+  Epoch[3] Train-accuracy=0.934
+  Epoch[3] Validation-accuracy=0.921
+  Epoch[3] Time cost=12.345
+
+Usage: python tools/parse_log.py train.log [--format md|csv]
+"""
+import argparse
+import re
+import sys
+
+_SPEED = re.compile(
+    r"Epoch\[(\d+)\].*Speed: ([\d.]+) samples/sec")
+_TRAIN = re.compile(r"Epoch\[(\d+)\] Train-(\S+)=([\d.eE+-]+)")
+_VAL = re.compile(r"Epoch\[(\d+)\] Validation-(\S+)=([\d.eE+-]+)")
+_TIME = re.compile(r"Epoch\[(\d+)\] Time cost=([\d.]+)")
+
+
+def parse(lines):
+    """-> {epoch: {"speed": [..], "train": {m: v}, "val": {m: v},
+                   "time": s}}"""
+    epochs = {}
+
+    def ep(i):
+        return epochs.setdefault(
+            int(i), {"speed": [], "train": {}, "val": {},
+                     "time": None})
+
+    for line in lines:
+        m = _SPEED.search(line)
+        if m:
+            ep(m.group(1))["speed"].append(float(m.group(2)))
+            continue
+        m = _TRAIN.search(line)
+        if m:
+            ep(m.group(1))["train"][m.group(2)] = float(m.group(3))
+            continue
+        m = _VAL.search(line)
+        if m:
+            ep(m.group(1))["val"][m.group(2)] = float(m.group(3))
+            continue
+        m = _TIME.search(line)
+        if m:
+            ep(m.group(1))["time"] = float(m.group(2))
+    return epochs
+
+
+def render(epochs, fmt="md"):
+    metrics = sorted({m for e in epochs.values()
+                      for m in list(e["train"]) + list(e["val"])})
+    cols = ["epoch", "speed(avg)"] + \
+        [f"train-{m}" for m in metrics] + \
+        [f"val-{m}" for m in metrics] + ["time(s)"]
+    rows = []
+    for i in sorted(epochs):
+        e = epochs[i]
+        speed = (sum(e["speed"]) / len(e["speed"])
+                 if e["speed"] else None)
+
+        def f(v):
+            return "" if v is None else f"{v:.4g}"
+
+        rows.append([str(i), f(speed)] +
+                    [f(e["train"].get(m)) for m in metrics] +
+                    [f(e["val"].get(m)) for m in metrics] +
+                    [f(e["time"])])
+    if fmt == "csv":
+        return "\n".join(",".join(r) for r in [cols] + rows)
+    w = [max(len(r[i]) for r in [cols] + rows)
+         for i in range(len(cols))]
+    line = "| " + " | ".join(c.ljust(x) for c, x in zip(cols, w)) + " |"
+    sep = "|" + "|".join("-" * (x + 2) for x in w) + "|"
+    body = ["| " + " | ".join(c.ljust(x) for c, x in zip(r, w)) + " |"
+            for r in rows]
+    return "\n".join([line, sep] + body)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("logfile")
+    ap.add_argument("--format", choices=("md", "csv"), default="md")
+    args = ap.parse_args(argv)
+    with open(args.logfile) as fh:
+        print(render(parse(fh), args.format))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

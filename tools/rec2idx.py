@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""Rebuild the .idx for an existing .rec file (ref: tools/rec2idx.py).
+
+Sequentially reads every record, recording its byte offset and the
+record id from the IRHeader (falling back to the ordinal when the
+payload is not IRHeader-packed), then writes 'key\\tpos' lines — the
+format MXIndexedRecordIO reads for random access / shuffling.
+
+Usage: python tools/rec2idx.py data.rec [data.idx]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), ".."))
+
+
+def build_index(rec_path, idx_path=None):
+    from incubator_mxnet_tpu import recordio as rio
+
+    idx_path = idx_path or os.path.splitext(rec_path)[0] + ".idx"
+    reader = rio.MXRecordIO(rec_path, "r")
+    entries = []
+    try:
+        while True:
+            pos = reader.tell()
+            rec = reader.read()
+            if rec is None:
+                break
+            try:
+                header, _ = rio.unpack(rec)
+                key = int(header.id)
+            except Exception:
+                key = len(entries)
+            entries.append((key, pos))
+    finally:
+        reader.close()
+    with open(idx_path, "w") as f:
+        for key, pos in entries:
+            f.write(f"{key}\t{pos}\n")
+    return idx_path, len(entries)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("rec")
+    ap.add_argument("idx", nargs="?", default=None)
+    args = ap.parse_args(argv)
+    idx_path, n = build_index(args.rec, args.idx)
+    print(f"wrote {n} entries to {idx_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
