@@ -37,7 +37,7 @@ def maybe_force_cpu():
                     "op", stacklevel=2)
                 return False
             return True
-    except ImportError:
+    except (ImportError, AttributeError):
         pass
     jax.config.update("jax_platforms", "cpu")
     return True
