@@ -40,6 +40,10 @@ def parse_args(argv=None):
                    help="bf16 compute with fp32 masters")
     p.add_argument("--seq-parallel", action="store_true",
                    help="ring attention over the mesh 'sp' axis")
+    p.add_argument("--grad-accum", type=int, default=1,
+                   help="micro-batches per step (memory lever)")
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize activations in backward")
     p.add_argument("--quick", action="store_true",
                    help="small run + convergence gate (CI)")
     return p.parse_args(argv)
@@ -83,7 +87,8 @@ def main(argv=None):
         loss_fn=lm_loss, seq_axis=1 if args.seq_parallel else None,
         example_args=[mx.nd.array(
             np.zeros((2, args.seq_len), "int32"))],
-        compute_dtype=jnp.bfloat16 if args.bf16 else None)
+        compute_dtype=jnp.bfloat16 if args.bf16 else None,
+        grad_accum=args.grad_accum, remat=args.remat)
 
     rs = np.random.RandomState(0)
     first_loss = last_loss = None

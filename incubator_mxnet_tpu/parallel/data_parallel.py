@@ -69,12 +69,19 @@ class ShardedTrainStep:
         update — the reference's multi_precision / mp_sgd path (ref:
         src/operator/optimizer_op.cc MP_SGD), laid out TPU-style so
         the MXU sees bf16 operands.
+    grad_accum : >1 splits the global batch into that many
+        micro-batches inside ONE compiled step (lax.scan over grads),
+        for effective batch sizes past the per-step memory budget.
+        Global batch must be divisible by grad_accum (and the
+        micro-batch by the 'dp' size).
+    remat : rematerialize the forward during backward
+        (jax.checkpoint) — activations recomputed, not stored.
     """
 
     def __init__(self, block, optimizer="sgd", optimizer_params=None,
                  mesh=None, loss_fn=None, rules=None, batch_axis=0,
                  seq_axis=None, donate=True, example_args=None,
-                 compute_dtype=None):
+                 compute_dtype=None, grad_accum=1, remat=False):
         if mesh is None:
             mesh = current_mesh()  # ambient mesh from use_mesh(...)
         self.mesh = mesh if mesh is not None else make_mesh()
@@ -94,6 +101,8 @@ class ShardedTrainStep:
         self.seq_axis = seq_axis
         self._donate = donate
         self.compute_dtype = compute_dtype
+        self.grad_accum = max(1, int(grad_accum))
+        self.remat = bool(remat)
 
         # -- lay out current values over the mesh --------------------
         pvals = self.pure.params()
@@ -117,19 +126,67 @@ class ShardedTrainStep:
     def _build(self, x, y):
         pure, loss_fn, opt = self.pure, self.loss_fn, self.opt
         cdt = self.compute_dtype
+        accum = int(self.grad_accum)
+        apply = pure.apply
+        if self.remat:
+            # rematerialize the forward during backward: activations
+            # are recomputed instead of stored, trading MXU FLOPs for
+            # HBM — the jax.checkpoint lever the TPU memory budget
+            # usually wants for long sequences / deep nets
+            apply = jax.checkpoint(
+                lambda p, s, xs, rng: pure.apply(
+                    p, s, xs, rng, training=True))
 
-        def step(params, states, opt_state, x, y, rng):
+        def grad_of(params, states, xb, yb, rng):
             def lossf(p):
-                xin = x
+                xin = xb
                 if cdt is not None:
                     p = _cast_floats(p, cdt)
-                    xin = _cast_floats(x, cdt)
-                outs, new_states = pure.apply(p, states, [xin], rng,
-                                              training=True)
-                return loss_fn(outs, y), new_states
+                    xin = _cast_floats(xb, cdt)
+                outs, new_states = apply(p, states, [xin], rng)
+                return loss_fn(outs, yb), new_states
+            return jax.value_and_grad(lossf, has_aux=True)(params)
 
-            (loss, new_states), grads = jax.value_and_grad(
-                lossf, has_aux=True)(params)
+        if accum > 1:
+            if self.batch_axis != 0:
+                raise ValueError(
+                    "grad_accum > 1 requires batch_axis=0 (the "
+                    "micro-batch split slices axis 0); move the "
+                    "batch to axis 0 or accumulate manually")
+            if x.shape[0] % accum != 0:
+                raise ValueError(
+                    f"global batch {x.shape[0]} is not divisible by "
+                    f"grad_accum={accum}")
+
+        def step(params, states, opt_state, x, y, rng):
+            if accum <= 1:
+                (loss, new_states), grads = grad_of(
+                    params, states, x, y, rng)
+            else:
+                # micro-batch scan: grads accumulate, aux states
+                # (BN moving stats) thread through sequentially —
+                # one compiled step regardless of accum factor
+                xm = x.reshape((accum, x.shape[0] // accum)
+                               + x.shape[1:])
+                ym = y.reshape((accum, y.shape[0] // accum)
+                               + y.shape[1:])
+                rngs = jax.random.split(rng, accum)
+
+                def micro(carry, xyr):
+                    gsum, lsum, st = carry
+                    xb, yb, r = xyr
+                    (loss, new_st), g = grad_of(params, st, xb, yb, r)
+                    gsum = jax.tree_util.tree_map(
+                        lambda a, b: a + b, gsum, g)
+                    return (gsum, lsum + loss, new_st), None
+
+                zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+                (gsum, lsum, new_states), _ = jax.lax.scan(
+                    micro, (zeros, jnp.zeros((), jnp.float32),
+                            states), (xm, ym, rngs))
+                grads = jax.tree_util.tree_map(
+                    lambda g: g / accum, gsum)
+                loss = lsum / accum
             new_params, new_opt = opt.update(params, grads, opt_state)
             return new_params, new_states, new_opt, loss
 

@@ -310,3 +310,76 @@ def test_write_back_then_step_keeps_parameters_alive():
     for p in net.collect_params().values():
         assert not p.data()._data.is_deleted()
         np.asarray(p.data()._data)  # still readable
+
+
+def test_grad_accum_matches_full_batch():
+    from incubator_mxnet_tpu import gluon
+
+    def build(**kw):
+        mx.random.seed(0)
+        net = gluon.nn.Dense(4, in_units=8, prefix="ga_")
+        net.initialize(mx.initializer.Xavier())
+        return parallel.ShardedTrainStep(
+            net, optimizer="sgd",
+            optimizer_params=dict(learning_rate=0.1),
+            example_args=[jnp.zeros((2, 8), jnp.float32)], **kw)
+
+    rs = np.random.RandomState(0)
+    xs = jnp.asarray(rs.rand(16, 8), jnp.float32)
+    ys = jnp.asarray(rs.randint(0, 4, (16,)), jnp.int32)
+    full = build()
+    acc = build(grad_accum=2)
+    l_full = [float(full(xs, ys)) for _ in range(3)]
+    l_acc = [float(acc(xs, ys)) for _ in range(3)]
+    # mean-of-micro-grads == full-batch grads for a linear net
+    np.testing.assert_allclose(l_acc, l_full, rtol=1e-5)
+
+
+def test_remat_matches_plain():
+    from incubator_mxnet_tpu import gluon
+
+    def build(**kw):
+        mx.random.seed(1)
+        net = gluon.nn.HybridSequential(prefix="rm_")
+        with net.name_scope():
+            net.add(gluon.nn.Dense(16, activation="relu"),
+                    gluon.nn.Dense(4))
+        net.initialize(mx.initializer.Xavier())
+        return parallel.ShardedTrainStep(
+            net, optimizer="sgd",
+            optimizer_params=dict(learning_rate=0.1),
+            example_args=[jnp.zeros((2, 8), jnp.float32)], **kw)
+
+    rs = np.random.RandomState(1)
+    xs = jnp.asarray(rs.rand(16, 8), jnp.float32)
+    ys = jnp.asarray(rs.randint(0, 4, (16,)), jnp.int32)
+    plain = build()
+    remat = build(remat=True)
+    l_plain = [float(plain(xs, ys)) for _ in range(3)]
+    l_remat = [float(remat(xs, ys)) for _ in range(3)]
+    np.testing.assert_allclose(l_remat, l_plain, rtol=1e-5)
+
+
+def test_grad_accum_guards():
+    import pytest
+    from incubator_mxnet_tpu import gluon
+
+    mx.random.seed(0)
+    net = gluon.nn.Dense(4, in_units=8, prefix="gg_")
+    net.initialize(mx.initializer.Xavier())
+    step = parallel.ShardedTrainStep(
+        net, optimizer="sgd", optimizer_params=dict(learning_rate=.1),
+        example_args=[jnp.zeros((2, 8), jnp.float32)], grad_accum=3)
+    with pytest.raises(ValueError, match="divisible"):
+        step(jnp.zeros((16, 8), jnp.float32),
+             jnp.zeros((16,), jnp.int32))
+    mx.random.seed(0)
+    net2 = gluon.nn.Dense(4, in_units=8, prefix="gh_")
+    net2.initialize(mx.initializer.Xavier())
+    step2 = parallel.ShardedTrainStep(
+        net2, optimizer="sgd",
+        optimizer_params=dict(learning_rate=.1), batch_axis=1,
+        example_args=[jnp.zeros((2, 8), jnp.float32)], grad_accum=2)
+    with pytest.raises(ValueError, match="batch_axis"):
+        step2(jnp.zeros((8, 16), jnp.float32),
+              jnp.zeros((16,), jnp.int32))
