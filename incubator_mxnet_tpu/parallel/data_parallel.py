@@ -76,12 +76,16 @@ class ShardedTrainStep:
         micro-batch by the 'dp' size).
     remat : rematerialize the forward during backward
         (jax.checkpoint) — activations recomputed, not stored.
+    lr_schedule : callable(step:int32 tracer) -> lr, evaluated INSIDE
+        the compiled step (optim.warmup_cosine / warmup_linear, or
+        any jnp-traceable function) — no per-step recompiles.
     """
 
     def __init__(self, block, optimizer="sgd", optimizer_params=None,
                  mesh=None, loss_fn=None, rules=None, batch_axis=0,
                  seq_axis=None, donate=True, example_args=None,
-                 compute_dtype=None, grad_accum=1, remat=False):
+                 compute_dtype=None, grad_accum=1, remat=False,
+                 lr_schedule=None):
         if mesh is None:
             mesh = current_mesh()  # ambient mesh from use_mesh(...)
         self.mesh = mesh if mesh is not None else make_mesh()
@@ -103,6 +107,8 @@ class ShardedTrainStep:
         self.compute_dtype = compute_dtype
         self.grad_accum = max(1, int(grad_accum))
         self.remat = bool(remat)
+        self.lr_schedule = lr_schedule
+        self.step_count = jnp.zeros((), jnp.int32)
 
         # -- lay out current values over the mesh --------------------
         pvals = self.pure.params()
@@ -158,7 +164,9 @@ class ShardedTrainStep:
                     f"global batch {x.shape[0]} is not divisible by "
                     f"grad_accum={accum}")
 
-        def step(params, states, opt_state, x, y, rng):
+        sched = self.lr_schedule
+
+        def step(params, states, opt_state, t, x, y, rng):
             if accum <= 1:
                 (loss, new_states), grads = grad_of(
                     params, states, x, y, rng)
@@ -187,16 +195,19 @@ class ShardedTrainStep:
                 grads = jax.tree_util.tree_map(
                     lambda g: g / accum, gsum)
                 loss = lsum / accum
-            new_params, new_opt = opt.update(params, grads, opt_state)
-            return new_params, new_states, new_opt, loss
+            lr = sched(t) if sched is not None else None
+            new_params, new_opt = opt.update(params, grads, opt_state,
+                                             lr=lr)
+            return new_params, new_states, new_opt, t + 1, loss
 
         in_sh = (self.param_shardings, self.state_shardings,
                  None,  # opt state: inherit param sharding via init
+                 None,  # step count
                  self._input_sharding(x.ndim),
                  self._input_sharding(y.ndim, is_label=True),
                  None)
         out_sh = (self.param_shardings, self.state_shardings,
-                  None, NamedSharding(self.mesh, P()))
+                  None, None, NamedSharding(self.mesh, P()))
         donate = (0, 1, 2) if self._donate else ()
         return jax.jit(step, in_shardings=in_sh, out_shardings=out_sh,
                        donate_argnums=donate)
@@ -216,9 +227,10 @@ class ShardedTrainStep:
         # ambient, so mesh-aware blocks (e.g. ring attention) resolve
         # the step's mesh even when called outside use_mesh()
         with use_mesh(self.mesh):
-            self.params, self.states, self.opt_state, loss = \
-                self._step(self.params, self.states, self.opt_state,
-                           x, y, rng)
+            (self.params, self.states, self.opt_state,
+             self.step_count, loss) = self._step(
+                self.params, self.states, self.opt_state,
+                self.step_count, x, y, rng)
         return loss
 
     step = __call__
@@ -288,20 +300,32 @@ class ShardedTrainStep:
                 sh = rep
             return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh)
 
-        target = jax.tree_util.tree_map(
-            spec, {"params": self.params, "states": self.states,
-                   "opt_state": self.opt_state})
+        tree = {"params": self.params, "states": self.states,
+                "opt_state": self.opt_state,
+                "step_count": self.step_count}
+        target = jax.tree_util.tree_map(spec, tree)
         with ocp.StandardCheckpointer() as ckptr:
-            restored = ckptr.restore(path, target)
+            try:
+                restored = ckptr.restore(path, target)
+            except ValueError as e:
+                if "step_count" not in str(e):
+                    raise
+                # checkpoint predates the step counter: restore the
+                # rest and resume the schedule from 0
+                del target["step_count"]
+                restored = ckptr.restore(path, target)
+                restored["step_count"] = jnp.zeros((), jnp.int32)
         self.params = restored["params"]
         self.states = restored["states"]
         self.opt_state = restored["opt_state"]
+        self.step_count = restored["step_count"]
 
     def _ckpt_tree(self):
         # generic pytree copy (opt_state nests beyond a flat dict)
         return _copy_tree({"params": self.params,
-                             "states": self.states,
-                             "opt_state": self.opt_state})
+                           "states": self.states,
+                           "opt_state": self.opt_state,
+                           "step_count": self.step_count})
 
 
 def _raw(a):

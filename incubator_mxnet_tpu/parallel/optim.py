@@ -17,7 +17,8 @@ and keep bf16 compute params alongside fp32 master weights.
 import jax
 import jax.numpy as jnp
 
-__all__ = ["FunctionalOptimizer", "sgd", "adam", "create"]
+__all__ = ["FunctionalOptimizer", "sgd", "adam", "create",
+           "warmup_cosine", "warmup_linear"]
 
 
 def _tree_map(f, *trees, **kw):
@@ -53,6 +54,40 @@ def scheduled_lr(opt):
     if opt.lr_scheduler is not None:
         return opt.lr_scheduler(opt.num_update)
     return opt.lr
+
+
+def _warmup_then(peak_lr, warmup_steps, total_steps, decay_fn):
+    """Shared schedule shape: linear warmup to ``peak_lr`` over
+    ``warmup_steps`` updates, then ``decay_fn(frac)`` where frac runs
+    0->1 over the remaining steps.  Uses (t+1) so the FIRST update
+    already has a non-zero lr — the same increment-then-read
+    convention as the eager path (lr_scheduler.WarmupScheduler,
+    optim.scheduled_lr).  jnp-traceable in the step count, so the
+    whole schedule lives inside the compiled step (no per-step
+    recompiles)."""
+    def lr(t):
+        u = jnp.asarray(t, jnp.float32) + 1.0
+        warm = peak_lr * u / jnp.maximum(1.0, warmup_steps)
+        frac = jnp.clip((u - warmup_steps)
+                        / jnp.maximum(1.0, total_steps - warmup_steps),
+                        0.0, 1.0)
+        return jnp.where(u < warmup_steps, warm, decay_fn(frac))
+    return lr
+
+
+def warmup_cosine(peak_lr, warmup_steps, total_steps, end_lr=0.0):
+    """Linear warmup then cosine decay to ``end_lr``."""
+    return _warmup_then(
+        peak_lr, warmup_steps, total_steps,
+        lambda f: end_lr + 0.5 * (peak_lr - end_lr)
+        * (1.0 + jnp.cos(jnp.pi * f)))
+
+
+def warmup_linear(peak_lr, warmup_steps, total_steps, end_lr=0.0):
+    """Linear warmup then linear decay to ``end_lr``."""
+    return _warmup_then(
+        peak_lr, warmup_steps, total_steps,
+        lambda f: peak_lr + (end_lr - peak_lr) * f)
 
 
 class FunctionalOptimizer:
