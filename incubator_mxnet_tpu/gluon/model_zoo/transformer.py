@@ -186,21 +186,30 @@ class TransformerLM(Block):
     _GEN_CACHE_MAX = 16   # compiled decode executables kept (FIFO)
 
     def generate(self, tokens, max_new_tokens, temperature=0.0,
-                 rng=None):
+                 top_k=0, top_p=1.0, rng=None):
         """Autoregressive decode with a KV cache, TPU-native: ONE
         batched prefill forward seeds the cache for the whole prompt,
         then ONE ``lax.scan`` emits the new tokens.  Static shapes
         throughout; compiled once per (batch, prompt_len,
-        max_new_tokens) signature (bounded FIFO of executables — pad
-        prompts to a few fixed lengths to maximise compile reuse).
+        max_new_tokens, sampling-config) signature (bounded FIFO of
+        executables — pad prompts to a few fixed lengths and keep the
+        sampling config stable to maximise compile reuse).
 
         tokens : (B, P) int NDArray/numpy prompt
         temperature : 0 -> greedy argmax, >0 -> categorical sample
+        top_k : keep only the k highest-probability tokens (0 = all)
+        top_p : nucleus sampling — keep the smallest set of tokens
+            whose cumulative probability exceeds top_p (1.0 = all)
         returns (B, P + max_new_tokens) int32 NDArray
         """
         import jax
         import jax.numpy as jnp
 
+        if top_k < 0:
+            raise ValueError(f"top_k must be >= 0 (got {top_k})")
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(
+                f"top_p must be in (0, 1] (got {top_p})")
         toks_np = np.asarray(
             tokens.asnumpy() if hasattr(tokens, "asnumpy")
             else tokens).astype(np.int32)
@@ -222,7 +231,12 @@ class TransformerLM(Block):
                 self.forward(nd.NDArray(jnp.zeros((1, 1), jnp.int32)))
             wts = self._decode_weights()
 
-        key = (b, p, int(max_new_tokens), temperature > 0)
+        sampling = temperature > 0
+        # greedy ignores the sampling filters: normalize them out of
+        # the compile key so greedy callers share one executable
+        key = (b, p, int(max_new_tokens), sampling,
+               int(top_k) if sampling else 0,
+               float(top_p) if sampling else 1.0)
         cache = getattr(self, "_gen_cache", None)
         if cache is None:
             cache = self._gen_cache = {}
@@ -230,7 +244,8 @@ class TransformerLM(Block):
             if len(cache) >= self._GEN_CACHE_MAX:
                 cache.pop(next(iter(cache)))
             cache[key] = jax.jit(self._build_decode(
-                b, p, int(max_new_tokens), temperature > 0))
+                b, p, int(max_new_tokens), temperature > 0,
+                top_k=int(top_k), top_p=float(top_p)))
         fn = cache[key]
         if rng is None:
             rng = jax.random.PRNGKey(0)
@@ -256,7 +271,8 @@ class TransformerLM(Block):
                     ln_f=(w(self.ln_f.gamma), w(self.ln_f.beta)),
                     head=w(self.head.weight), layers=layers)
 
-    def _build_decode(self, b, p, max_new, sample):
+    def _build_decode(self, b, p, max_new, sample, top_k=0,
+                      top_p=1.0):
         import jax
         import jax.numpy as jnp
         from jax import lax
@@ -271,10 +287,29 @@ class TransformerLM(Block):
             var = jnp.mean((x - mu) ** 2, -1, keepdims=True)
             return (x - mu) / jnp.sqrt(var + 1e-5) * gb[0] + gb[1]
 
+        def restrict(logits):
+            """top-k / nucleus filtering on (B, V) logits."""
+            if top_k and top_k < logits.shape[-1]:
+                kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+                logits = jnp.where(logits < kth, -jnp.inf, logits)
+            if top_p < 1.0:
+                sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]
+                probs = jax.nn.softmax(sorted_l, axis=-1)
+                cum = jnp.cumsum(probs, axis=-1)
+                # number of tokens needed to reach top_p (>= 1)
+                k_eff = jnp.maximum(
+                    jnp.sum(cum - probs < top_p, axis=-1,
+                            keepdims=True), 1)
+                cutoff = jnp.take_along_axis(sorted_l, k_eff - 1,
+                                             axis=-1)
+                logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+            return logits
+
         def pick(logits, temp, rng):
             if sample:
                 rng, sub = jax.random.split(rng)
-                nxt = jax.random.categorical(sub, logits / temp)
+                nxt = jax.random.categorical(
+                    sub, restrict(logits / temp))
             else:
                 nxt = jnp.argmax(logits, axis=-1)
             return nxt.astype(jnp.int32), rng

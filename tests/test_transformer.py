@@ -236,3 +236,49 @@ def test_sharded_step_traces_with_own_mesh_outside_scope():
         tf_mod.CausalSelfAttention._ring_mesh = orig
     assert np.isfinite(loss)
     assert any(ring_calls), "ring path never engaged during trace"
+
+
+def test_generate_top_k_restricts_support():
+    # every sampled continuation token must be in the per-step top-2
+    # of the same model's full-forward logits
+    net = _tiny(max_len=16)
+    prompt = np.random.RandomState(5).randint(0, 37, (1, 4)) \
+        .astype("int32")
+    out = net.generate(mx.nd.array(prompt), max_new_tokens=5,
+                       temperature=1.0, top_k=2,
+                       rng=jax.random.PRNGKey(3)).asnumpy()
+    cur = prompt.copy()
+    for t in range(5):
+        logits = net(mx.nd.array(cur)).asnumpy()[:, -1]
+        top2 = set(np.argsort(logits[0])[-2:].tolist())
+        assert int(out[0, 4 + t]) in top2, (t, out, top2)
+        cur = np.concatenate(
+            [cur, out[:, 4 + t:5 + t].astype("int32")], axis=1)
+
+
+def test_generate_top_p_one_keeps_all_and_top_k1_is_greedy():
+    net = _tiny(max_len=16)
+    prompt = mx.nd.array(np.zeros((1, 4), "int32"))
+    greedy = net.generate(prompt, 5).asnumpy()
+    k1 = net.generate(prompt, 5, temperature=1.0, top_k=1,
+                      rng=jax.random.PRNGKey(0)).asnumpy()
+    np.testing.assert_array_equal(k1, greedy)
+    # top_p just under 1.0 with a tiny nucleus also stays on-support
+    s = net.generate(prompt, 5, temperature=1.0, top_p=0.05,
+                     rng=jax.random.PRNGKey(0)).asnumpy()
+    np.testing.assert_array_equal(s, greedy)  # nucleus of ~1 = argmax
+
+
+def test_generate_sampling_arg_validation():
+    import pytest
+    net = _tiny(max_len=16)
+    prompt = mx.nd.array(np.zeros((1, 4), "int32"))
+    with pytest.raises(ValueError, match="top_k"):
+        net.generate(prompt, 2, temperature=1.0, top_k=-1)
+    with pytest.raises(ValueError, match="top_p"):
+        net.generate(prompt, 2, temperature=1.0, top_p=0.0)
+    # greedy ignores the filters and shares one executable
+    net._gen_cache = {}
+    net.generate(prompt, 2)
+    net.generate(prompt, 2, top_k=50, top_p=0.9)
+    assert len(net._gen_cache) == 1
