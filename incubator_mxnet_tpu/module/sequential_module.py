@@ -1,0 +1,162 @@
+"""SequentialModule: chain modules head-to-tail (ref:
+python/mxnet/module/sequential_module.py SequentialModule:28).
+
+Each sub-module's outputs feed the next one's data inputs; backward
+runs the chain in reverse, handing each module's input gradients to
+its predecessor as output gradients.  The last module owns the
+labels/loss.  The TPU caveat is latency, not correctness: each
+sub-module is its own compiled executable, so a K-stage chain pays K
+dispatches per step — single-symbol Module fuses into one; use this
+when stages genuinely need separate binding (e.g. mixed grad_req or
+staged freezing).
+"""
+import logging
+
+from .base_module import BaseModule
+
+
+class SequentialModule(BaseModule):
+    """(ref: sequential_module.py:28)"""
+
+    META_TAKE_LABELS = "take_labels"
+
+    def __init__(self, logger=logging):
+        super().__init__(logger)
+        self._modules = []
+        self._metas = []
+        self._label_shapes = None
+
+    def add(self, module, **kwargs):
+        """Append a sub-module.  ``take_labels=True`` marks the one
+        fed the labels (normally the last, with the loss)."""
+        self._modules.append(module)
+        self._metas.append(kwargs)
+        self.binded = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+        return self
+
+    # ------------------------------------------------------------ names
+    @property
+    def data_names(self):
+        return self._modules[0].data_names if self._modules else []
+
+    @property
+    def output_names(self):
+        return self._modules[-1].output_names if self._modules else []
+
+    @property
+    def data_shapes(self):
+        return self._modules[0].data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        return self._modules[-1].output_shapes
+
+    # ------------------------------------------------------------ params
+    def get_params(self):
+        arg, aux = {}, {}
+        for m in self._modules:
+            a, x = m.get_params()
+            dup = (set(arg) & set(a)) | (set(aux) & set(x))
+            if dup:
+                raise ValueError(
+                    f"duplicate parameter names across sub-modules: "
+                    f"{sorted(dup)}; give stages distinct layer "
+                    "names")
+            arg.update(a)
+            aux.update(x)
+        return arg, aux
+
+    def init_params(self, initializer=None, arg_params=None,
+                    aux_params=None, allow_missing=False,
+                    force_init=False, allow_extra=False):
+        for m in self._modules:
+            # each sub-module sees the other stages' keys as extras,
+            # so allow_extra is forced; missing-key strictness is the
+            # caller's choice and passes through
+            m.init_params(initializer=initializer,
+                          arg_params=arg_params,
+                          aux_params=aux_params,
+                          allow_missing=allow_missing,
+                          force_init=force_init, allow_extra=True)
+        self.params_initialized = True
+
+    # ------------------------------------------------------------ bind
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False,
+             shared_module=None, grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        assert self._modules, "add() sub-modules before bind()"
+        self._label_shapes = label_shapes
+        shapes = list(data_shapes)
+        n = len(self._modules)
+        from ..io.io import DataDesc
+        for i, (m, meta) in enumerate(zip(self._modules, self._metas)):
+            takes_labels = meta.get(self.META_TAKE_LABELS,
+                                    i == n - 1)
+            # every module but the first needs grads flowing back in
+            m.bind(shapes,
+                   label_shapes=label_shapes if takes_labels else None,
+                   for_training=for_training,
+                   inputs_need_grad=inputs_need_grad or i > 0,
+                   force_rebind=force_rebind, grad_req=grad_req)
+            if i + 1 < n:
+                # wire this module's outputs to the next one's data
+                next_names = self._modules[i + 1].data_names
+                shapes = [DataDesc(nn, tuple(os[1]))
+                          for nn, os in zip(next_names,
+                                            m.output_shapes)]
+        self.binded = True
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=None, force_init=False):
+        for m in self._modules:
+            m.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                             optimizer_params=optimizer_params,
+                             force_init=force_init)
+        self.optimizer_initialized = True
+
+    # ------------------------------------------------------------ step
+    def forward(self, data_batch, is_train=None):
+        from ..io.io import DataBatch
+        batch = data_batch
+        n = len(self._modules)
+        for i, m in enumerate(self._modules):
+            m.forward(batch, is_train=is_train)
+            if i + 1 == n:
+                break
+            batch = DataBatch(m.get_outputs(),
+                              data_batch.label, pad=data_batch.pad)
+
+    def backward(self, out_grads=None):
+        grads = out_grads
+        for i in range(len(self._modules) - 1, -1, -1):
+            self._modules[i].backward(out_grads=grads)
+            if i > 0:
+                grads = self._modules[i].get_input_grads()
+
+    def update(self):
+        for m in self._modules:
+            m.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._modules[-1].get_outputs(merge_multi_context)
+
+    def get_input_grads(self, merge_multi_context=True):
+        return self._modules[0].get_input_grads(merge_multi_context)
+
+    def update_metric(self, eval_metric, labels):
+        n = len(self._modules)
+        for i, (m, meta) in enumerate(zip(self._modules, self._metas)):
+            if meta.get(self.META_TAKE_LABELS, i == n - 1):
+                m.update_metric(eval_metric, labels)
+
+    def install_monitor(self, mon):
+        for m in self._modules:
+            m.install_monitor(mon)

@@ -1,0 +1,86 @@
+"""SequentialModule + PythonModule/PythonLossModule (ref:
+python/mxnet/module/sequential_module.py:28, python_module.py:28)."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import sym
+from incubator_mxnet_tpu.io.io import DataBatch, DataDesc
+
+
+def _stage1():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=16, name="s1fc")
+    return sym.Activation(net, act_type="relu")
+
+
+def _stage2():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=4, name="s2fc")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def _batches(n=20, b=8):
+    rs = np.random.RandomState(0)
+    for _ in range(n):
+        x = rs.rand(b, 6).astype("float32")
+        y = (x[:, 0] > 0.5).astype("float32")
+        yield DataBatch([mx.nd.array(x)], [mx.nd.array(y)])
+
+
+def test_sequential_module_trains():
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.mod.Module(_stage1(), label_names=[]))
+    seq.add(mx.mod.Module(_stage2()), take_labels=True)
+    seq.bind(data_shapes=[DataDesc("data", (8, 6))],
+             label_shapes=[DataDesc("softmax_label", (8,))])
+    seq.init_params(initializer=mx.initializer.Xavier())
+    seq.init_optimizer(optimizer="sgd",
+                       optimizer_params=dict(learning_rate=0.5))
+    first = last = None
+    for epoch in range(15):
+        for batch in _batches():
+            seq.forward(batch, is_train=True)
+            seq.backward()
+            seq.update()
+        out = seq.get_outputs()[0].asnumpy()
+        y = batch.label[0].asnumpy()
+        acc = (out.argmax(1) == y).mean()
+        if first is None:
+            first = acc
+        last = acc
+    assert last >= 0.85, (first, last)
+    arg, _ = seq.get_params()
+    assert "s1fc_weight" in arg and "s2fc_weight" in arg
+
+
+def test_python_loss_module_in_chain():
+    # stage: linear scores -> python MSE-style loss head
+    data = sym.Variable("data")
+    scores = sym.FullyConnected(data, num_hidden=1, name="lin")
+
+    def grad_func(label, pred):
+        # d/dpred of 0.5*(pred - label)^2
+        return pred - label.reshape(pred.shape)
+
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.mod.Module(scores, label_names=[]))
+    seq.add(mx.mod.PythonLossModule(grad_func=grad_func),
+            take_labels=True)
+    seq.bind(data_shapes=[DataDesc("data", (8, 3))],
+             label_shapes=[DataDesc("softmax_label", (8,))])
+    seq.init_params(initializer=mx.initializer.Xavier())
+    seq.init_optimizer(optimizer="sgd",
+                       optimizer_params=dict(learning_rate=0.2))
+    rs = np.random.RandomState(1)
+    w_true = np.array([[1.0], [-2.0], [0.5]], np.float32)
+    losses = []
+    for _ in range(150):
+        x = rs.rand(8, 3).astype("float32")
+        y = (x @ w_true).ravel()
+        batch = DataBatch([mx.nd.array(x)], [mx.nd.array(y)])
+        seq.forward(batch, is_train=True)
+        pred = seq.get_outputs()[0].asnumpy().ravel()
+        losses.append(float(((pred - y) ** 2).mean()))
+        seq.backward()
+        seq.update()
+    assert losses[-1] < losses[0] * 0.1, (losses[0], losses[-1])
