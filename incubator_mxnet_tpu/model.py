@@ -8,7 +8,7 @@ from . import ndarray as nd
 from . import symbol as sym
 
 __all__ = ["BatchEndParam", "save_checkpoint", "load_checkpoint",
-           "_create_kvstore"]
+           "_create_kvstore", "FeedForward"]
 
 BatchEndParam = collections.namedtuple(
     "BatchEndParams", ["epoch", "nbatch", "eval_metric", "locals"])
@@ -71,3 +71,152 @@ def load_checkpoint(prefix, epoch):
     save_dict = nd.load(f"{prefix}-{epoch:04d}.params")
     arg_params, aux_params = split_tagged_params(save_dict)
     return symbol, arg_params, aux_params
+
+
+class FeedForward:
+    """Legacy estimator API (ref: python/mxnet/model.py
+    FeedForward:408): fit/predict/score over numpy arrays or
+    DataIters, implemented as a thin shell around Module — the
+    compiled-executor training path is identical; this class only
+    adds the sklearn-ish ergonomics the reference's oldest examples
+    use.
+    """
+
+    def __init__(self, symbol, ctx=None, num_epoch=None,
+                 optimizer="sgd", initializer=None,
+                 numpy_batch_size=128, arg_params=None,
+                 aux_params=None, allow_extra_params=False,
+                 begin_epoch=0, **kwargs):
+        from .module import Module
+
+        self.symbol = symbol
+        self.ctx = ctx
+        self.num_epoch = num_epoch
+        self.optimizer = optimizer
+        self.optimizer_params = kwargs
+        self.initializer = initializer
+        self.numpy_batch_size = numpy_batch_size
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.allow_extra_params = allow_extra_params
+        self.begin_epoch = begin_epoch
+        self._mod_cls = Module
+        self._module = None
+
+    # ------------------------------------------------------------ data
+    def _as_iter(self, X, y=None, shuffle=False):
+        from .io.io import DataIter, NDArrayIter
+        if isinstance(X, DataIter):
+            return X
+        import numpy as _np
+        X = _np.asarray(X)
+        if y is not None:
+            y = _np.asarray(y, _np.float32)
+        return NDArrayIter(X, y, batch_size=min(self.numpy_batch_size,
+                                                len(X)),
+                           shuffle=shuffle,
+                           label_name="softmax_label")
+
+    # ------------------------------------------------------------ train
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", logger=None):
+        """(ref: model.py FeedForward.fit:609)"""
+        import logging as _logging
+
+        from . import initializer as init_mod
+
+        train = self._as_iter(X, y, shuffle=True)
+        if isinstance(eval_data, tuple):
+            eval_data = self._as_iter(*eval_data)
+        mod = self._mod_cls(self.symbol, context=self.ctx,
+                            logger=logger or _logging)
+        self._module = mod
+        mod.fit(train, eval_data=eval_data, eval_metric=eval_metric,
+                epoch_end_callback=epoch_end_callback,
+                batch_end_callback=batch_end_callback,
+                kvstore=kvstore, optimizer=self.optimizer,
+                optimizer_params=self.optimizer_params or None,
+                initializer=self.initializer or init_mod.Uniform(0.01),
+                arg_params=self.arg_params,
+                aux_params=self.aux_params,
+                allow_missing=self.arg_params is not None,
+                begin_epoch=self.begin_epoch,
+                # num_epoch is the END epoch (reference semantics):
+                # a loaded model with begin_epoch=N continues for at
+                # least one epoch unless told otherwise
+                num_epoch=self.num_epoch if self.num_epoch is not None
+                else self.begin_epoch + 1)
+        self.arg_params, self.aux_params = mod.get_params()
+        return self
+
+    # ------------------------------------------------------------ infer
+    def _bound_module(self, data_iter):
+        if self._module is not None and self._module.binded:
+            return self._module
+        assert self.arg_params is not None, "fit() or load() first"
+        # loss heads (SoftmaxOutput...) keep their label argument in
+        # the graph; at inference it only needs a shape, so bind a
+        # dummy (batch,) desc per *_label argument
+        from .io.io import DataDesc
+        batch = data_iter.provide_data[0].shape[0]
+        label_names = [n for n in self.symbol.list_arguments()
+                       if n.endswith("_label")]
+        mod = self._mod_cls(self.symbol, context=self.ctx,
+                            label_names=label_names)
+        mod.bind(data_shapes=data_iter.provide_data,
+                 label_shapes=[DataDesc(n, (batch,))
+                               for n in label_names] or None,
+                 for_training=False)
+        mod.set_params(self.arg_params, self.aux_params or {},
+                       allow_extra=self.allow_extra_params)
+        self._module = mod
+        return mod
+
+    def predict(self, X, num_batch=None):
+        """Forward over X -> numpy (ref: FeedForward.predict:521);
+        delegates to BaseModule.predict (pad-stripped, merged)."""
+        import numpy as _np
+        data_iter = self._as_iter(X)
+        mod = self._bound_module(data_iter)
+        out = mod.predict(data_iter, num_batch=num_batch)
+        return _np.asarray(out.asnumpy() if not isinstance(out, list)
+                           else out[0].asnumpy())
+
+    def score(self, X, y=None, eval_metric="acc", num_batch=None):
+        """(ref: FeedForward.score:571); delegates to
+        BaseModule.score (pad-aware)."""
+        data_iter = self._as_iter(X, y)
+        mod = self._bound_module(data_iter)
+        return mod.score(data_iter, eval_metric,
+                         num_batch=num_batch)[0][1]
+
+    # ------------------------------------------------------------ io
+    def save(self, prefix, epoch=None):
+        """(ref: FeedForward.save:371)"""
+        save_checkpoint(prefix, epoch if epoch is not None
+                        else (self.num_epoch or 0), self.symbol,
+                        self.arg_params or {}, self.aux_params or {})
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        """(ref: FeedForward.load:389)"""
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params,
+                           begin_epoch=epoch, **kwargs)
+
+    @staticmethod
+    def create(symbol, X, y=None, ctx=None, num_epoch=None,
+               optimizer="sgd", initializer=None, eval_data=None,
+               eval_metric="acc", epoch_end_callback=None,
+               batch_end_callback=None, kvstore="local", **kwargs):
+        """Train in one call (ref: FeedForward.create:927)."""
+        model = FeedForward(symbol, ctx=ctx, num_epoch=num_epoch,
+                            optimizer=optimizer,
+                            initializer=initializer, **kwargs)
+        model.fit(X, y, eval_data=eval_data, eval_metric=eval_metric,
+                  epoch_end_callback=epoch_end_callback,
+                  batch_end_callback=batch_end_callback,
+                  kvstore=kvstore)
+        return model

@@ -87,7 +87,16 @@ class BaseModule:
             if num_batch is not None and nbatch == num_batch:
                 break
             self.forward(eval_batch, is_train=False)
-            self.update_metric(eval_metric, eval_batch.label)
+            pad = getattr(eval_batch, "pad", 0) or 0
+            if pad:
+                # wrap-padded duplicates must not count in the score
+                outs = [o[:o.shape[0] - pad]
+                        for o in self.get_outputs()]
+                labels = [l[:l.shape[0] - pad]
+                          for l in eval_batch.label]
+                eval_metric.update(labels, outs)
+            else:
+                self.update_metric(eval_metric, eval_batch.label)
             if batch_end_callback is not None:
                 param = BatchEndParam(epoch, nbatch, eval_metric, locals())
                 for cb in _as_list(batch_end_callback):

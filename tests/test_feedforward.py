@@ -1,0 +1,60 @@
+"""FeedForward legacy estimator (ref: python/mxnet/model.py
+FeedForward:408 — numpy-in fit/predict/score + save/load)."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import sym
+from incubator_mxnet_tpu.model import FeedForward
+
+
+def _net():
+    data = sym.Variable("data")
+    net = sym.Activation(sym.FullyConnected(data, num_hidden=16,
+                                            name="ffc1"),
+                         act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=2, name="ffc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def _data(n=200):
+    rs = np.random.RandomState(0)
+    X = rs.rand(n, 6).astype("float32")
+    y = (X[:, 0] + X[:, 1] > 1.0).astype("float32")
+    return X, y
+
+
+def test_fit_predict_score_numpy():
+    import random as pyrandom
+    X, y = _data()
+    mx.random.seed(0)
+    np.random.seed(0)      # iterator shuffle order
+    pyrandom.seed(0)
+    model = FeedForward(_net(), num_epoch=25, learning_rate=0.5,
+                        numpy_batch_size=48)  # 200 % 48 != 0: pad path
+    model.fit(X, y)
+    acc = model.score(X, y)
+    assert acc > 0.9, acc
+    preds = model.predict(X)
+    assert preds.shape == (200, 2)
+    assert ((preds.argmax(1) == y).mean()) == acc
+
+
+def test_create_and_save_load_roundtrip(tmp_path):
+    X, y = _data(120)
+    mx.random.seed(0)
+    model = FeedForward.create(_net(), X, y, num_epoch=8,
+                               learning_rate=0.5,
+                               numpy_batch_size=24)
+    preds = model.predict(X)
+    prefix = str(tmp_path / "ff")
+    model.save(prefix, 8)
+    loaded = FeedForward.load(prefix, 8)
+    preds2 = loaded.predict(X)
+    np.testing.assert_allclose(preds2, preds, rtol=1e-5, atol=1e-6)
+
+
+def test_predict_requires_params():
+    import pytest
+    model = FeedForward(_net())
+    with pytest.raises(AssertionError, match="fit"):
+        model.predict(np.zeros((4, 6), "float32"))
