@@ -45,17 +45,20 @@ def maybe_block(value):
 
 
 def wait_all():
-    """Block until all pending device work is complete."""
-    try:
-        jax.effects_barrier()
-    except Exception:
-        pass
-    # touching a fresh computation forces the queue to drain per-device
-    for d in jax.devices():
-        try:
-            jax.device_put(0, d).block_until_ready()
-        except Exception:
-            pass
+    """Block until all pending device work is complete.
+
+    Failures must surface: a dead backend raising here is the signal
+    the caller asked for — swallowing it would turn "wait for
+    completion" into a silent no-op.  Only the absence of
+    ``effects_barrier`` on older jax is tolerated."""
+    barrier = getattr(jax, "effects_barrier", None)
+    if barrier is not None:
+        barrier()
+    # touching a fresh computation forces the queue to drain per-device;
+    # local_devices only — a process cannot (and need not) wait on
+    # devices addressable only by other hosts
+    for d in jax.local_devices():
+        jax.device_put(0, d).block_until_ready()
 
 
 def wait(values):

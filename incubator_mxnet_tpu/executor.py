@@ -28,12 +28,21 @@ from .symbol.symbol import _topo
 __all__ = ["Executor", "build_graph_fn"]
 
 
-def build_graph_fn(symbol):
+def build_graph_fn(symbol, placements=None, default_device=None):
     """Build the pure evaluation function of a Symbol graph.
 
     Returns fn(arg_vals: dict, aux_vals: dict, rng, is_train) ->
     (outputs: list, aux_updates: dict) suitable for jax.jit
     (is_train static).
+
+    ``placements`` (id(node) -> jax.Device) activates multi-device
+    placement — the TPU-native reading of the reference's PlaceDevice
+    pass (ref: src/executor/graph_executor.cc:411): each node's inputs
+    are ``jax.device_put`` to its group's device (the _CrossDeviceCopy
+    analog; differentiable, so vjp replays transfers in reverse), and
+    the node's eager op then executes there.  Placed graphs must run
+    UN-jitted (explicit per-device transfer is not expressible inside
+    a single-device jit trace).
     """
     order = _topo(symbol._heads)
     heads = list(symbol._heads)
@@ -54,6 +63,9 @@ def build_graph_fn(symbol):
                 continue
             op = node.op
             ins = [env[(id(n), i)] for n, i in node.inputs]
+            if placements is not None:
+                dev = placements.get(id(node), default_device)
+                ins = [jax.device_put(x, dev) for x in ins]
             params = dict(node.params)
             if op.needs_mode:
                 params["_training"] = is_train
@@ -83,12 +95,37 @@ def _ones_ct(o):
     return np.zeros(o.shape, jax.dtypes.float0)
 
 
+def _scan_ctx_groups(symbol, group2ctx):
+    """Validate group2ctx and resolve it against the graph.
+
+    Returns (placements, var_ctx): ``placements`` maps id(op node) ->
+    jax.Device for every node whose ``ctx_group`` attr names a mapped
+    group; ``var_ctx`` maps variable name -> Context for allocation.
+    """
+    for g, c in group2ctx.items():
+        if not hasattr(c, "jax_device"):
+            raise TypeError(
+                f"group2ctx[{g!r}] must be a Context, got "
+                f"{type(c).__name__}")
+    placements, var_ctx = {}, {}
+    for node in _topo(symbol._heads):
+        grp = node.attrs.get("ctx_group")
+        if grp is None or grp not in group2ctx:
+            continue
+        if node.is_variable:
+            var_ctx[node.name] = group2ctx[grp]
+        else:
+            placements[id(node)] = group2ctx[grp].jax_device
+    return placements, var_ctx
+
+
 class Executor:
     """A bound, compiled computation graph
     (ref: include/mxnet/executor.h Forward/Backward)."""
 
     def __init__(self, symbol, ctx=None, args=None, args_grad=None,
-                 grad_req="write", aux_states=None, shared_exec=None):
+                 grad_req="write", aux_states=None, shared_exec=None,
+                 group2ctx=None):
         self._symbol = symbol
         self._ctx = ctx or default_context()
         arg_names = symbol.list_arguments()
@@ -113,7 +150,25 @@ class Executor:
             if self._grad_req.get(n, "null") != "null"
             and args_grad.get(n) is not None}
 
-        self._run = build_graph_fn(symbol)
+        # group2ctx placement (ref: graph_executor.cc PlaceDevice:411):
+        # map each node's ctx_group attribute onto a concrete device.
+        # Placed execution skips whole-graph jit (see build_graph_fn);
+        # groups absent from group2ctx fall back to the bind ctx, and
+        # an all-same-device mapping degenerates to the fast jit path.
+        self._group2ctx = dict(group2ctx) if group2ctx else None
+        self._placed = False
+        placements = None
+        if group2ctx:
+            placements, _ = _scan_ctx_groups(symbol, group2ctx)
+            default_dev = self._ctx.jax_device
+            if any(d != default_dev for d in placements.values()):
+                self._placed = True
+            else:
+                placements = None       # degenerate: single device
+
+        self._run = build_graph_fn(
+            symbol, placements=placements,
+            default_device=self._ctx.jax_device if placements else None)
         self._jit_fwd = {}
         self._jit_fwd_bwd = {}
         self._outputs = None
@@ -170,7 +225,7 @@ class Executor:
 
             def f(arg_vals, aux_vals, rng):
                 return run(arg_vals, aux_vals, rng, is_train)
-            self._jit_fwd[is_train] = jax.jit(f)
+            self._jit_fwd[is_train] = f if self._placed else jax.jit(f)
         return self._jit_fwd[is_train]
 
     def _set_inputs(self, kwargs):
@@ -236,10 +291,12 @@ class Executor:
                 return outs, aux_upd, dict(zip(grad_names, gvals))
 
             if with_head_grads:
-                self._jit_fwd_bwd[key] = jax.jit(f)
+                self._jit_fwd_bwd[key] = \
+                    f if self._placed else jax.jit(f)
             else:
-                self._jit_fwd_bwd[key] = jax.jit(
-                    lambda a, x, r: f(a, x, r, None))
+                g = lambda a, x, r: f(a, x, r, None)
+                self._jit_fwd_bwd[key] = \
+                    g if self._placed else jax.jit(g)
         return self._jit_fwd_bwd[key]
 
     def backward(self, out_grads=None):
@@ -309,11 +366,12 @@ class Executor:
         type_dict.update({k: v.dtype for k, v in self.aux_dict.items()})
         return Executor._simple_bind(
             self._symbol, self._ctx,
-            self._grad_req, type_dict, shapes, _copy_from=self)
+            self._grad_req, type_dict, shapes, _copy_from=self,
+            group2ctx=self._group2ctx)
 
     @classmethod
     def _simple_bind(cls, symbol, ctx, grad_req, type_dict, shape_kwargs,
-                     _copy_from=None):
+                     _copy_from=None, group2ctx=None):
         """Allocate all arrays from inferred shapes and bind
         (ref: MXExecutorSimpleBind, c_api_executor.cc:220)."""
         arg_shapes, _, aux_shapes = symbol.infer_shape(**shape_kwargs)
@@ -328,23 +386,36 @@ class Executor:
                 "name=/mx.name.Prefix scopes")
         aux_names = symbol.list_auxiliary_states()
         type_dict = type_dict or {}
+        # with group2ctx, variables tagged ctx_group get their arrays
+        # allocated on (and committed to) the group's device, matching
+        # the reference's per-group arg allocation
+        var_ctx = {}
+        if group2ctx:
+            _, var_ctx = _scan_ctx_groups(symbol, group2ctx)
+
+        def _alloc(n, s, dt):
+            c = var_ctx.get(n, ctx)
+            buf = jnp.zeros(s, dt)
+            if n in var_ctx:
+                buf = jax.device_put(buf, c.jax_device)
+            return NDArray(buf, c)
+
         args = {}
         for n, s in zip(arg_names, arg_shapes):
-            dt = np_dtype(type_dict.get(n, "float32"))
-            args[n] = NDArray(jnp.zeros(s, dt), ctx)
+            args[n] = _alloc(n, s, np_dtype(type_dict.get(n, "float32")))
         aux = {}
         for n, s in zip(aux_names, aux_shapes):
-            dt = np_dtype(type_dict.get(n, "float32"))
-            aux[n] = NDArray(jnp.zeros(s, dt), ctx)
+            aux[n] = _alloc(n, s, np_dtype(type_dict.get(n, "float32")))
         if isinstance(grad_req, str):
             req = {n: grad_req for n in arg_names}
         elif isinstance(grad_req, (list, tuple)):
             req = dict(zip(arg_names, grad_req))
         else:
             req = dict(grad_req)
-        grads = {n: NDArray(jnp.zeros_like(args[n]._data), ctx)
+        grads = {n: NDArray(jnp.zeros_like(args[n]._data),
+                            var_ctx.get(n, ctx))
                  for n in arg_names if req.get(n, "null") != "null"}
-        ex = cls(symbol, ctx, args, grads, req, aux)
+        ex = cls(symbol, ctx, args, grads, req, aux, group2ctx=group2ctx)
         if _copy_from is not None:
             for k, v in _copy_from.arg_dict.items():
                 if k in ex.arg_dict and v.shape == ex.arg_dict[k].shape:

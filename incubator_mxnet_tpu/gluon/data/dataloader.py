@@ -16,6 +16,13 @@ and maps the batch bytes out of ``/dev/shm``.  Same design here:
   transforms scale past the GIL exactly like the reference's
   process workers.
 
+.. note:: migration
+   Earlier rounds defaulted ``num_workers>0`` to *threads*; processes
+   are now the default (reference parity).  Custom ``batchify_fn``s
+   that build NDArrays must stay numpy-only under processes (an error
+   is raised when an accelerator is live); pass ``thread_pool=True``
+   to keep the previous thread-based behavior unchanged.
+
 Workers deliberately touch only numpy: forking a process that has
 already initialized an accelerator backend is only safe if the child
 never re-enters that runtime, so batchify inside workers produces
@@ -265,10 +272,15 @@ class DataLoader:
                                os.urandom(4).hex())
         accel = _accel_backend_initialized()
         with warnings.catch_warnings():
-            # the at-fork warnings (jax's RuntimeWarning, CPython
-            # 3.12's multi-threaded-fork DeprecationWarning) do not
-            # apply: the children are numpy-only
-            warnings.filterwarnings("ignore", message=".*fork.*")
+            # the at-fork warnings do not apply (the children are
+            # numpy-only), but only those two specific warnings are
+            # known-benign — anything else about fork must surface
+            warnings.filterwarnings(
+                "ignore", category=RuntimeWarning,
+                message=r"os\.fork\(\) was called\.")
+            warnings.filterwarnings(
+                "ignore", category=DeprecationWarning,
+                message=r"This process .* is multi-threaded")
             pool = _mp.get_context("fork").Pool(
                 self._num_workers, initializer=_worker_init,
                 initargs=(self._dataset, worker_batchify, prefix,
@@ -276,33 +288,42 @@ class DataLoader:
         try:
             import time as _time
             grace = float(os.environ.get("MXTPU_DL_DEAD_GRACE", "60"))
-            initial_pids = {w.pid for w in getattr(pool, "_pool", [])}
-            for res in _bounded_window(
+            # respawn-generation bookkeeping: a task is only suspect
+            # if the worker set changed AFTER it was submitted.  A
+            # global "pids look healthy now" snapshot cannot express
+            # that (a batch completing after a respawn would reset it
+            # and mask an earlier lost task forever).
+            known_pids = {w.pid for w in getattr(pool, "_pool", [])}
+            respawn_gen = 0
+
+            def _observe_pids():
+                nonlocal known_pids, respawn_gen
+                pids = {w.pid for w in getattr(pool, "_pool", [])}
+                if pids != known_pids:
+                    respawn_gen += 1
+                    known_pids = pids
+                return respawn_gen
+
+            for res, submit_gen in _bounded_window(
                     self._batch_sampler,
-                    lambda idxs: pool.apply_async(_worker_fn, (idxs,)),
+                    lambda idxs: (pool.apply_async(_worker_fn, (idxs,)),
+                                  respawn_gen),
                     2 * self._num_workers):
                 # poll with a timeout: if a worker dies hard (native
                 # segfault, OOM-kill), Pool respawns it but the lost
                 # task's result never arrives — a bare get() would
-                # hang the training loop forever.  A pid change alone
-                # is not proof THIS result is lost (the died worker
-                # may have held a different task), so the result gets
-                # a grace window after the first observed change.
+                # hang the training loop forever.  A respawn alone is
+                # not proof THIS result is lost (the died worker may
+                # have held a different task), so a result submitted
+                # before the respawn gets a grace window to arrive.
                 deadline = None
                 while True:
                     try:
                         desc = res.get(5.0)
-                        # a completed batch proves the current worker
-                        # set is healthy: re-snapshot so an earlier
-                        # benign respawn can't trip later batches
-                        initial_pids = {
-                            w.pid for w in getattr(pool, "_pool", [])}
                         break
                     except _mp.TimeoutError:
-                        pids = {w.pid
-                                for w in getattr(pool, "_pool", [])}
-                        if pids == initial_pids:
-                            continue
+                        if _observe_pids() == submit_gen:
+                            continue    # no respawn since submission
                         if deadline is None:
                             deadline = _time.monotonic() + grace
                         elif _time.monotonic() > deadline:

@@ -233,10 +233,15 @@ class ImageDetIter(ImageIter):
             (batch_size, self._max_objs, self._obj_width))]
 
     def _estimate_label_shape(self, max_objects):
-        """Scan the WHOLE dataset's labels (no image decode) for
-        (max objects, obj width) — a partial window would make a
-        crowded late sample overflow the padded label mid-epoch
-        (ref: detection.py _estimate_label_shape)."""
+        """(max objects, obj width) for the padded label.
+
+        With ``max_objects`` given, only the first sample is read (for
+        obj_width) — the runtime overflow check in :meth:`next` is the
+        safety net, so a large .rec file pays no extra full pass.
+        Without it, scan the WHOLE dataset's labels (no image decode):
+        a partial window would make a crowded late sample overflow the
+        padded label mid-epoch (ref: detection.py
+        _estimate_label_shape)."""
         max_objs, obj_width = 1, 5
         while True:
             sample = self._next_sample(decode=False)
@@ -245,8 +250,13 @@ class ImageDetIter(ImageIter):
             objs = _parse_det_label(sample[0])
             max_objs = max(max_objs, objs.shape[0])
             obj_width = max(obj_width, objs.shape[1])
+            if max_objects is not None:
+                break       # first sample fixes obj_width; cap given
         self.reset()
         if max_objects is not None:
+            # floor, not exact cap: an overcrowded first sample (which
+            # was parsed anyway) must widen the padding rather than
+            # fail mid-epoch in next()
             max_objs = max(max_objs, int(max_objects))
         return max_objs, obj_width
 

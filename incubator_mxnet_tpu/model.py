@@ -174,14 +174,17 @@ class FeedForward:
         return mod
 
     def predict(self, X, num_batch=None):
-        """Forward over X -> numpy (ref: FeedForward.predict:521);
-        delegates to BaseModule.predict (pad-stripped, merged)."""
+        """Forward over X -> numpy, one array per output — a list for
+        multi-output symbols (ref: FeedForward.predict:521); delegates
+        to BaseModule.predict (pad-stripped, merged)."""
         import numpy as _np
         data_iter = self._as_iter(X)
         mod = self._bound_module(data_iter)
         out = mod.predict(data_iter, num_batch=num_batch)
-        return _np.asarray(out.asnumpy() if not isinstance(out, list)
-                           else out[0].asnumpy())
+        if isinstance(out, list):
+            outs = [_np.asarray(o.asnumpy()) for o in out]
+            return outs[0] if len(outs) == 1 else outs
+        return _np.asarray(out.asnumpy())
 
     def score(self, X, y=None, eval_metric="acc", num_batch=None):
         """(ref: FeedForward.score:571); delegates to

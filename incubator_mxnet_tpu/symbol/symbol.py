@@ -381,16 +381,16 @@ class Symbol:
 
     # -------------------------------------------------------------- bind
     def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
-                    **kwargs):
+                    group2ctx=None, **kwargs):
         from ..executor import Executor
         return Executor._simple_bind(self, ctx, grad_req, type_dict,
-                                     kwargs)
+                                     kwargs, group2ctx=group2ctx)
 
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
              aux_states=None, group2ctx=None, shared_exec=None):
         from ..executor import Executor
         return Executor(self, ctx, args, args_grad, grad_req, aux_states,
-                        shared_exec=shared_exec)
+                        shared_exec=shared_exec, group2ctx=group2ctx)
 
     def eval(self, ctx=None, **kwargs):
         ex = self.bind(ctx, kwargs)

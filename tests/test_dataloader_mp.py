@@ -195,3 +195,30 @@ def test_thread_pool_mode_still_works():
     for _, pid_batch in loader:
         pids.update(int(p) for p in pid_batch.asnumpy())
     assert pids == {os.getpid()}
+
+
+class _CrashDataset(gluon.data.Dataset):
+    """idx 3 kills its worker; idx 0/1 are slow enough that their
+    batch completes only after the pool has respawned the dead
+    worker — the masking scenario the respawn-generation logic
+    exists for (a global pid re-snapshot would hang forever)."""
+
+    def __len__(self):
+        return 12
+
+    def __getitem__(self, idx):
+        if idx in (0, 1):
+            time.sleep(2.0)
+        if idx == 3:
+            time.sleep(0.5)
+            os._exit(1)
+        return np.full((2,), idx, dtype="float32")
+
+
+def test_mp_loader_dead_worker_raises_not_hangs(monkeypatch):
+    monkeypatch.setenv("MXTPU_DL_DEAD_GRACE", "6")
+    loader = gluon.data.DataLoader(_CrashDataset(), batch_size=2,
+                                   num_workers=2)
+    with pytest.raises(RuntimeError, match="worker died"):
+        for _ in loader:
+            pass

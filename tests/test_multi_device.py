@@ -1,0 +1,103 @@
+"""group2ctx placement (ref: tests/python/unittest/
+test_multi_device_exec.py:22 test_ctx_group).
+
+On the 8-virtual-CPU-device conftest mesh, cpu(1)/cpu(2) are distinct
+jax devices, so placement and the cross-device copies are real."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+
+
+def _mlp():
+    with mx.AttrScope(ctx_group="stage1"):
+        data = mx.sym.Variable("data")
+        fc1 = mx.sym.FullyConnected(data=data, name="fc1",
+                                    num_hidden=32)
+        act1 = mx.sym.Activation(data=fc1, name="relu1",
+                                 act_type="relu")
+    set_stage1 = set(act1.list_arguments())
+    with mx.AttrScope(ctx_group="stage2"):
+        fc2 = mx.sym.FullyConnected(data=act1, name="fc2",
+                                    num_hidden=16)
+        act2 = mx.sym.Activation(data=fc2, name="relu2",
+                                 act_type="relu")
+        fc3 = mx.sym.FullyConnected(data=act2, name="fc3",
+                                    num_hidden=10)
+        mlp = mx.sym.SoftmaxOutput(data=fc3, name="softmax")
+    return mlp, set_stage1
+
+
+@pytest.mark.parametrize("grad_req", ["write", "null_data"])
+def test_ctx_group_placement_and_numerics(grad_req):
+    mlp, set_stage1 = _mlp()
+    group2ctx = {"stage1": mx.cpu(1), "stage2": mx.cpu(2)}
+    if grad_req == "null_data":
+        grad_req = {a: ("null" if a == "data" else "write")
+                    for a in mlp.list_arguments()}
+    texec = mlp.simple_bind(mx.cpu(0), group2ctx=group2ctx,
+                            data=(4, 20), softmax_label=(4,),
+                            grad_req=grad_req)
+
+    # arg arrays allocated on their group's context (reference assert)
+    for arr, name in zip(texec.arg_arrays, mlp.list_arguments()):
+        want = group2ctx["stage1" if name in set_stage1 else "stage2"]
+        assert arr.context == want, (name, arr.context)
+        assert arr._data.devices() == {want.jax_device}
+
+    # numerics must match an un-grouped single-device bind
+    rng = np.random.RandomState(0)
+    vals = {n: rng.normal(size=a.shape).astype(np.float32)
+            for n, a in zip(mlp.list_arguments(), texec.arg_arrays)}
+    vals["softmax_label"] = rng.randint(
+        0, 10, size=(4,)).astype(np.float32)
+    ref = mlp.simple_bind(mx.cpu(0), data=(4, 20),
+                          softmax_label=(4,), grad_req="write")
+    for n in mlp.list_arguments():
+        texec.arg_dict[n][:] = vals[n]
+        ref.arg_dict[n][:] = vals[n]
+    out = texec.forward(is_train=True)[0].asnumpy()
+    out_ref = ref.forward(is_train=True)[0].asnumpy()
+    np.testing.assert_allclose(out, out_ref, rtol=1e-5, atol=1e-6)
+
+    texec.backward()
+    ref.backward()
+    for n in mlp.list_arguments():
+        g, gr = texec.grad_dict.get(n), ref.grad_dict.get(n)
+        if g is None:
+            continue
+        # eager (placed) vs jit-fused execution reassociates float
+        # reductions; tolerance covers that, not a placement bug
+        np.testing.assert_allclose(g.asnumpy(), gr.asnumpy(),
+                                   rtol=5e-3, atol=1e-4)
+
+
+def test_ctx_group_same_device_degenerates_to_jit():
+    mlp, _ = _mlp()
+    texec = mlp.simple_bind(
+        mx.cpu(0), group2ctx={"stage1": mx.cpu(0),
+                              "stage2": mx.cpu(0)},
+        data=(2, 20), softmax_label=(2,), grad_req="write")
+    assert not texec._placed
+    texec.forward(is_train=False)
+
+
+def test_group2ctx_rejects_non_context():
+    mlp, _ = _mlp()
+    with pytest.raises(TypeError):
+        mlp.simple_bind(mx.cpu(0), group2ctx={"stage1": "cpu"},
+                        data=(2, 20), softmax_label=(2,))
+
+
+def test_reshape_preserves_group2ctx():
+    mlp, set_stage1 = _mlp()
+    group2ctx = {"stage1": mx.cpu(1), "stage2": mx.cpu(2)}
+    texec = mlp.simple_bind(mx.cpu(0), group2ctx=group2ctx,
+                            data=(4, 20), softmax_label=(4,),
+                            grad_req="write")
+    assert texec._placed
+    bigger = texec.reshape(data=(8, 20), softmax_label=(8,))
+    assert bigger._placed
+    for arr, name in zip(bigger.arg_arrays, mlp.list_arguments()):
+        want = group2ctx["stage1" if name in set_stage1 else "stage2"]
+        assert arr.context == want, (name, arr.context)
