@@ -1,0 +1,80 @@
+"""Custom Pallas kernel as a first-class operator — the mx.rtc story
+on TPU (ref: python/mxnet/rtc.py:1; the reference compiles raw CUDA
+source at runtime, here the user-extensible kernel layer is Pallas).
+
+A fused scale-shift-relu kernel: one VMEM pass instead of three
+elementwise ops, registered with a hand-written VJP and then used
+from eager nd, a symbolic Executor, and a hybridized Gluon block.
+
+Runs anywhere: Pallas interpret mode is auto-selected off-TPU.
+"""
+import os
+import sys
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+# runnable from anywhere: put the repo root on sys.path
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, nd, rtc
+
+
+def fused_scale_shift_relu_kernel(x_ref, o_ref, *, alpha, beta):
+    o_ref[...] = jnp.maximum(x_ref[...] * alpha + beta, 0.0)
+
+
+fused = rtc.compile_kernel(
+    fused_scale_shift_relu_kernel,
+    out_shape=lambda x, alpha=1.0, beta=0.0: jax.ShapeDtypeStruct(
+        x.shape, x.dtype))
+
+
+def _vjp_fwd(x, alpha=1.0, beta=0.0):
+    y = fused(x, alpha=alpha, beta=beta)
+    return y, (y,)                      # mask from the output
+
+
+def _vjp_bwd(alpha, beta, res, g):
+    (y,) = res
+    return (g * (y > 0) * alpha,)
+
+
+rtc.register("scale_shift_relu", fused, arg_names=["data"],
+             vjp=(_vjp_fwd, _vjp_bwd))
+
+
+def main():
+    x = nd.array(np.linspace(-2, 2, 12, dtype=np.float32).reshape(3, 4))
+
+    # eager + autograd
+    x.attach_grad()
+    with autograd.record():
+        y = nd.scale_shift_relu(x, alpha=2.0, beta=0.5)
+    y.backward()
+    print("eager out[0]:", y.asnumpy()[0], " grad[0]:",
+          x.grad.asnumpy()[0])
+
+    # symbolic graph -> fused XLA executable
+    s = mx.sym.scale_shift_relu(mx.sym.Variable("data"),
+                                alpha=2.0, beta=0.5)
+    out = s.eval(mx.cpu(0), data=x)[0]
+    assert np.allclose(out.asnumpy(), y.asnumpy())
+
+    # gluon hybridized
+    class Net(mx.gluon.HybridBlock):
+        def hybrid_forward(self, F, v):
+            return F.scale_shift_relu(v, alpha=2.0, beta=0.5)
+
+    net = Net()
+    net.hybridize()
+    assert np.allclose(net(x).asnumpy(), y.asnumpy())
+    print("symbolic + gluon paths match. custom kernel OK")
+
+
+if __name__ == "__main__":
+    main()

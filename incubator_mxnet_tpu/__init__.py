@@ -41,6 +41,7 @@ from . import lr_scheduler
 from . import metric
 from . import callback
 from . import kvstore
+from . import kvstore as kv
 from . import model
 from . import test_utils
 from . import dist
@@ -56,6 +57,7 @@ from . import rnn
 from . import recordio
 from . import image
 from . import operator
+from . import rtc
 from . import profiler
 from . import monitor
 from .monitor import Monitor

@@ -125,7 +125,7 @@ class Executor:
 
     def __init__(self, symbol, ctx=None, args=None, args_grad=None,
                  grad_req="write", aux_states=None, shared_exec=None,
-                 group2ctx=None):
+                 group2ctx=None, _ctx_group_scan=None):
         self._symbol = symbol
         self._ctx = ctx or default_context()
         arg_names = symbol.list_arguments()
@@ -157,18 +157,31 @@ class Executor:
         # an all-same-device mapping degenerates to the fast jit path.
         self._group2ctx = dict(group2ctx) if group2ctx else None
         self._placed = False
+        self._out_ctx = None
         placements = None
         if group2ctx:
-            placements, _ = _scan_ctx_groups(symbol, group2ctx)
+            placements, var_ctx = _ctx_group_scan or \
+                _scan_ctx_groups(symbol, group2ctx)
             default_dev = self._ctx.jax_device
-            if any(d != default_dev for d in placements.values()):
+            # variable-only tags still force placed (eager) execution:
+            # their arrays are committed to group devices, which a
+            # single-device jit would reject as incompatible inputs
+            if any(d != default_dev for d in placements.values()) or \
+                    any(c.jax_device != default_dev
+                        for c in var_ctx.values()):
                 self._placed = True
+                # outputs carry the context of the head node's group
+                # (reference: outputs live on their group's ctx)
+                self._out_ctx = [
+                    group2ctx.get(n.attrs.get("ctx_group"), self._ctx)
+                    for n, _ in symbol._heads]
             else:
                 placements = None       # degenerate: single device
 
         self._run = build_graph_fn(
-            symbol, placements=placements,
-            default_device=self._ctx.jax_device if placements else None)
+            symbol, placements=placements if self._placed else None,
+            default_device=self._ctx.jax_device if self._placed
+            else None)
         self._jit_fwd = {}
         self._jit_fwd_bwd = {}
         self._outputs = None
@@ -251,8 +264,12 @@ class Executor:
             self._jvals(self.arg_dict), self._jvals(self.aux_dict), rng)
         for name, val in aux_upd.items():
             self.aux_dict[name]._data = val
-        self._outputs = [NDArray(o, self._ctx) for o in outs]
+        self._outputs = self._wrap_outputs(outs)
         return self._outputs
+
+    def _wrap_outputs(self, outs):
+        ctxs = self._out_ctx or [self._ctx] * len(outs)
+        return [NDArray(o, c) for o, c in zip(outs, ctxs)]
 
     # ------------------------------------------------------------- backward
     def _grad_names(self):
@@ -336,7 +353,7 @@ class Executor:
                 buf._data = buf._data + g
             else:
                 buf._data = g
-        self._outputs = [NDArray(o, self._ctx) for o in outs]
+        self._outputs = self._wrap_outputs(outs)
         return self._outputs
 
     # ------------------------------------------------------------- misc
@@ -389,9 +406,10 @@ class Executor:
         # with group2ctx, variables tagged ctx_group get their arrays
         # allocated on (and committed to) the group's device, matching
         # the reference's per-group arg allocation
-        var_ctx = {}
+        var_ctx, scan = {}, None
         if group2ctx:
-            _, var_ctx = _scan_ctx_groups(symbol, group2ctx)
+            scan = _scan_ctx_groups(symbol, group2ctx)
+            var_ctx = scan[1]
 
         def _alloc(n, s, dt):
             c = var_ctx.get(n, ctx)
@@ -415,7 +433,8 @@ class Executor:
         grads = {n: NDArray(jnp.zeros_like(args[n]._data),
                             var_ctx.get(n, ctx))
                  for n in arg_names if req.get(n, "null") != "null"}
-        ex = cls(symbol, ctx, args, grads, req, aux, group2ctx=group2ctx)
+        ex = cls(symbol, ctx, args, grads, req, aux,
+                 group2ctx=group2ctx, _ctx_group_scan=scan)
         if _copy_from is not None:
             for k, v in _copy_from.arg_dict.items():
                 if k in ex.arg_dict and v.shape == ex.arg_dict[k].shape:

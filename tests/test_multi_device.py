@@ -101,3 +101,35 @@ def test_reshape_preserves_group2ctx():
     for arr, name in zip(bigger.arg_arrays, mlp.list_arguments()):
         want = group2ctx["stage1" if name in set_stage1 else "stage2"]
         assert arr.context == want, (name, arr.context)
+
+
+def test_variable_only_ctx_group_forces_placed_mode():
+    """Variables tagged with ctx_group but ops built outside the
+    scope: arrays commit to group devices, so jit would reject them
+    as incompatible inputs — must fall back to placed execution."""
+    with mx.AttrScope(ctx_group="stage1"):
+        data = mx.sym.Variable("data")
+        w = mx.sym.Variable("w")
+    with mx.AttrScope(ctx_group="stage2"):
+        b = mx.sym.Variable("b")
+    out = mx.sym.broadcast_add(mx.sym.dot(data, w), b)
+    ex = out.simple_bind(
+        mx.cpu(0), group2ctx={"stage1": mx.cpu(1),
+                              "stage2": mx.cpu(2)},
+        data=(2, 3), w=(3, 4), b=(1, 4), grad_req="write")
+    assert ex._placed
+    ex.arg_dict["data"][:] = np.ones((2, 3), np.float32)
+    ex.arg_dict["w"][:] = np.ones((3, 4), np.float32)
+    ex.arg_dict["b"][:] = np.ones((1, 4), np.float32)
+    res = ex.forward()[0]
+    np.testing.assert_allclose(res.asnumpy(), np.full((2, 4), 4.0))
+
+
+def test_placed_outputs_carry_group_context():
+    mlp, _ = _mlp()
+    group2ctx = {"stage1": mx.cpu(1), "stage2": mx.cpu(2)}
+    texec = mlp.simple_bind(mx.cpu(0), group2ctx=group2ctx,
+                            data=(4, 20), softmax_label=(4,),
+                            grad_req="write")
+    out = texec.forward()[0]
+    assert out.context == mx.cpu(2)       # head is in stage2

@@ -63,3 +63,23 @@ INFO Epoch[1] Validation-accuracy=0.69
     csv = parse_log.render(epochs, "csv")
     assert csv.splitlines()[0].startswith("epoch,speed")
     assert "200" in csv
+
+
+def test_bandwidth_tool_collectives_and_kvstore():
+    """tools/bandwidth.py (ref: tools/bandwidth/measure.py) runs all
+    benches on the 8-virtual-device mesh and reports sane records."""
+    import bandwidth
+    recs = bandwidth.main(["--benches", "collectives,kvstore,h2d",
+                           "--sizes-mb", "0.5", "--iters", "2"])
+    by_bench = {}
+    for r in recs:
+        by_bench.setdefault(r["bench"], []).append(r)
+    coll = by_bench["collectives"]
+    assert {c["op"] for c in coll} == {
+        "allreduce", "reduce_scatter", "all_gather", "ppermute"}
+    assert all(c["ms"] > 0 and c["bus_gbps"] > 0 for c in coll)
+    assert all(c["devices"] == 8 for c in coll)
+    kv = by_bench["kvstore"][0]
+    assert kv["payload_mb"] > 10 and kv["gbps"] > 0
+    h2d = by_bench["h2d"][0]
+    assert h2d["h2d_gbps"] > 0 and h2d["d2h_gbps"] > 0
