@@ -199,6 +199,138 @@ def _bench_transformer(dev, platform):
     }))
 
 
+def _make_synthetic_rec(path_prefix, n, edge=224):
+    """Write n real JPEGs (structured noise) into an indexed .rec."""
+    import io as _pyio
+
+    from PIL import Image
+
+    from incubator_mxnet_tpu import recordio as rio
+
+    rec = rio.MXIndexedRecordIO(path_prefix + ".idx",
+                                path_prefix + ".rec", "w")
+    rs = np.random.RandomState(7)
+    for i in range(n):
+        # smooth gradient + noise: compresses like a natural image,
+        # so decode cost is realistic (pure noise JPEGs decode slow)
+        gx = np.linspace(0, 255, edge, dtype=np.float32)
+        img = (gx[None, :, None] * 0.5 + gx[:, None, None] * 0.3
+               + rs.rand(edge, edge, 3) * 64).astype(np.uint8)
+        buf = _pyio.BytesIO()
+        Image.fromarray(img).save(buf, format="JPEG", quality=90)
+        rec.write_idx(i, rio.pack(
+            rio.IRHeader(0, float(i % 1000), i, 0), buf.getvalue()))
+    rec.close()
+
+
+def _bench_pipeline(dev, platform):
+    """End-to-end input pipeline: JPEG .rec → threaded decode →
+    DevicePrefetchIter (h2d overlap) → compiled ResNet-50 train step.
+    The number that matters is e2e img/s vs the naked-step img/s —
+    the reference's whole src/io/ exists to make those equal
+    (iter_prefetcher.h:47).  Run with MXTPU_BENCH_MODEL=pipeline."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import parallel
+
+    cpu = jax.devices("cpu")[0]
+    n_img = int(os.environ.get("MXTPU_BENCH_PIPE_IMGS", "512"))
+    net_kind = os.environ.get("MXTPU_BENCH_PIPE_NET", "resnet50")
+
+    with jax.default_device(cpu):
+        mx.random.seed(0)
+        if net_kind == "tiny":
+            # CPU-testable stand-in: same pipeline, cheap compute
+            net = mx.gluon.nn.HybridSequential()
+            with net.name_scope():
+                net.add(mx.gluon.nn.Conv2D(8, 3, strides=4,
+                                           activation="relu"),
+                        mx.gluon.nn.GlobalAvgPool2D(),
+                        mx.gluon.nn.Dense(1000))
+        else:
+            net = mx.gluon.model_zoo.vision.resnet50_v1()
+        net.initialize(mx.initializer.Xavier())
+        pure = parallel.functionalize(
+            net, jnp.zeros((1, 3, 224, 224), jnp.float32))
+
+    mesh_devs = [dev] if dev is not None else jax.devices("cpu")[:1]
+    compute_dtype = jnp.bfloat16 if platform != "cpu" else None
+    step = parallel.ShardedTrainStep(
+        pure, optimizer="sgd",
+        optimizer_params=dict(learning_rate=0.1, momentum=0.9),
+        mesh=parallel.make_mesh(devices=mesh_devs),
+        compute_dtype=compute_dtype)
+    rng = jax.random.PRNGKey(0)
+
+    with tempfile.TemporaryDirectory() as td:
+        prefix = os.path.join(td, "synth")
+        t0 = time.perf_counter()
+        _make_synthetic_rec(prefix, n_img)
+        gen_s = time.perf_counter() - t0
+
+        def make_iter():
+            return mx.io.ImageRecordIter(
+                path_imgrec=prefix + ".rec", data_shape=(3, 224, 224),
+                batch_size=BATCH, shuffle=False, preprocess_threads=8,
+                round_batch=True)
+
+        # (a) feed-only: decode+batch throughput, no device work
+        it = make_iter()
+        t0 = time.perf_counter()
+        n = sum(b.data[0].shape[0] for b in it)
+        feed_img_s = n / (time.perf_counter() - t0)
+
+        # (b) naked compiled step on one device-resident batch
+        it = make_iter()
+        batch = it.next()
+        tgt = mesh_devs[0]
+        x = jax.device_put(batch.data[0]._data, tgt)
+        y = jax.device_put(
+            np.asarray(batch.label[0].asnumpy(), np.int32), tgt)
+        for _ in range(3):
+            loss = step(x, y, rng=rng)
+        float(loss)
+        t0 = time.perf_counter()
+        for _ in range(10):
+            loss = step(x, y, rng=rng)
+        float(loss)
+        step_img_s = BATCH * 10 / (time.perf_counter() - t0)
+
+        # (c) end-to-end: rec decode → device prefetch → step
+        ctx = mx.tpu(0) if dev is not None else mx.cpu(0)
+        pre = mx.io.DevicePrefetchIter(make_iter(), ctx=ctx, depth=2)
+        t0 = time.perf_counter()
+        n = 0
+        loss = None
+        for b in pre:
+            y = b.label[0]._data.astype(jnp.int32)
+            loss = step(b.data[0]._data, y, rng=rng)
+            n += b.data[0].shape[0]
+        float(loss)
+        e2e_img_s = n / (time.perf_counter() - t0)
+
+    ratio = e2e_img_s / step_img_s
+    print(json.dumps({
+        "metric": f"{net_kind}_e2e_pipeline_batch{BATCH}_1chip",
+        "value": round(e2e_img_s, 2),
+        "unit": "samples/sec",
+        "vs_baseline": round(e2e_img_s / BASELINE_IMG_S, 3)
+        if BATCH == 32 else None,
+        "platform": platform,
+        "device_kind": getattr(dev, "device_kind", "cpu")
+        if dev is not None else "cpu",
+        "feed_only_img_s": round(feed_img_s, 2),
+        "naked_step_img_s": round(step_img_s, 2),
+        "e2e_over_step": round(ratio, 3),
+        "n_images": n_img,
+        "rec_gen_s": round(gen_s, 1),
+    }))
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -209,6 +341,9 @@ def main():
 
     if os.environ.get("MXTPU_BENCH_MODEL") == "transformer":
         _bench_transformer(dev, platform)
+        return
+    if os.environ.get("MXTPU_BENCH_MODEL") == "pipeline":
+        _bench_pipeline(dev, platform)
         return
 
     import incubator_mxnet_tpu as mx

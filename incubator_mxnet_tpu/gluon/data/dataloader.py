@@ -306,8 +306,11 @@ class DataLoader:
 
             for res, submit_gen in _bounded_window(
                     self._batch_sampler,
+                    # observe at submission: a respawn that happened
+                    # while no result was being polled must not count
+                    # against tasks submitted after it
                     lambda idxs: (pool.apply_async(_worker_fn, (idxs,)),
-                                  respawn_gen),
+                                  _observe_pids()),
                     2 * self._num_workers):
                 # poll with a timeout: if a worker dies hard (native
                 # segfault, OOM-kill), Pool respawns it but the lost

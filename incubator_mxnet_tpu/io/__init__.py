@@ -5,9 +5,9 @@ prefetcher) is re-created host-side: numpy/threads feed device
 buffers, with async device transfer riding JAX dispatch.
 """
 from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
-                 PrefetchingIter, CSVIter, MNISTIter, ImageRecordIter,
-                 LibSVMIter)
+                 PrefetchingIter, DevicePrefetchIter, CSVIter,
+                 MNISTIter, ImageRecordIter, LibSVMIter)
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter",
-           "ResizeIter", "PrefetchingIter", "CSVIter", "MNISTIter",
-           "ImageRecordIter", "LibSVMIter"]
+           "ResizeIter", "PrefetchingIter", "DevicePrefetchIter",
+           "CSVIter", "MNISTIter", "ImageRecordIter", "LibSVMIter"]

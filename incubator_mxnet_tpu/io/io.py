@@ -23,7 +23,8 @@ from ..ndarray import array as nd_array
 from ..ndarray.ndarray import NDArray
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter",
-           "ResizeIter", "PrefetchingIter", "CSVIter", "MNISTIter",
+           "ResizeIter", "PrefetchingIter", "DevicePrefetchIter",
+           "CSVIter", "MNISTIter",
            "LibSVMIter", "ImageRecordIter"]
 
 
@@ -335,6 +336,119 @@ class PrefetchingIter(DataIter):
         data = [d for b in batches for d in b.data]
         label = [l for b in batches for l in b.label]
         return DataBatch(data, label, pad=batches[0].pad)
+
+    def iter_next(self):
+        raise NotImplementedError("use next()")
+
+
+class DevicePrefetchIter(DataIter):
+    """Device-side double buffering: stage batch N+1's host→HBM
+    transfer while batch N's step computes.
+
+    The reference prefetches decoded batches into host memory
+    (src/io/iter_prefetcher.h:47); the TPU-side half of that overlap
+    is committing the batch to device memory *ahead* of the step, so
+    the compiled executable never stalls on transfer.  jax transfers
+    are started by a background thread here (``jax.device_put``
+    returns immediately with the copy in flight), bounded by
+    ``depth`` in-flight batches so HBM use stays at
+    ``depth × batch_bytes``.
+
+    Wrap any DataIter::
+
+        train = mx.io.DevicePrefetchIter(
+            mx.io.ImageRecordIter(...), ctx=mx.tpu(0))
+        module.fit(train, ...)
+    """
+
+    def __init__(self, data_iter, ctx=None, depth=2):
+        super().__init__(data_iter.batch_size)
+        from ..context import default_context
+        self._iter = data_iter
+        self._ctx = ctx or default_context()
+        self._depth = depth
+        self._spawn()
+
+    def _spawn(self):
+        self._queue = queue.Queue(maxsize=self._depth)
+        self._stop = threading.Event()
+        self._terminal = None
+        # the worker must capture ITS queue/stop: reading them off
+        # self would let a worker that outlives a reset() resurrect
+        # into the replacement queue
+        q, stop = self._queue, self._stop
+
+        def worker():
+            import jax
+            dev = self._ctx.jax_device
+            while not stop.is_set():
+                try:
+                    batch = self._iter.next()
+                except StopIteration:
+                    q.put(("end", None))
+                    return
+                except Exception as exc:     # surface in consumer
+                    q.put(("err", exc))
+                    return
+                try:
+                    stage = [NDArray(jax.device_put(a._data, dev),
+                                     self._ctx)
+                             for a in batch.data]
+                    label = [NDArray(jax.device_put(a._data, dev),
+                                     self._ctx)
+                             for a in (batch.label or [])]
+                except Exception as exc:
+                    q.put(("err", exc))
+                    return
+                q.put(("ok", DataBatch(
+                    stage, label, pad=batch.pad,
+                    provide_data=getattr(batch, "provide_data", None),
+                    provide_label=getattr(batch, "provide_label",
+                                          None))))
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    @property
+    def provide_data(self):
+        return self._iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self._iter.provide_label
+
+    def reset(self):
+        self._stop.set()
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=30)
+        if self._thread.is_alive():
+            # resetting the shared inner iterator under a live worker
+            # would interleave two consumers — fail loudly instead
+            raise RuntimeError(
+                "DevicePrefetchIter.reset: worker still blocked in "
+                "the inner iterator after 30s; it cannot be reset "
+                "safely (check the inner iterator for hangs)")
+        self._iter.reset()
+        self._spawn()
+
+    def next(self):
+        if self._terminal is not None:     # worker is gone: re-raise
+            kind, payload = self._terminal  # instead of blocking on a
+            if kind == "end":               # producerless queue
+                raise StopIteration
+            raise payload
+        kind, payload = self._queue.get()
+        if kind == "end":
+            self._terminal = (kind, payload)
+            raise StopIteration
+        if kind == "err":
+            self._terminal = (kind, payload)
+            raise payload
+        return payload
 
     def iter_next(self):
         raise NotImplementedError("use next()")

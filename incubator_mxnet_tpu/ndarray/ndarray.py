@@ -573,7 +573,11 @@ def imperative_invoke(op, args, kwargs, out=None):
     if out is not None:
         targets = out if isinstance(out, (tuple, list)) else [out]
         for t, o in zip(targets, out_arrays):
-            t._data = o._data
+            # reference out= semantics write INTO the target buffer:
+            # its dtype is preserved (a bf16 parameter stays bf16 when
+            # an fp32-producing initializer fills it)
+            t._data = o._data if o._data.dtype == t._data.dtype \
+                else o._data.astype(t._data.dtype)
             t._autograd = getattr(o, "_autograd", None)
         return out
     if len(out_arrays) == 1:

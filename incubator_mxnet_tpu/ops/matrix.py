@@ -5,6 +5,8 @@ swapaxis.cc, pad.cc, crop.cc, control_flow_op.cc, init_op.cc cast).
 On TPU, `dot`/`batch_dot` are the MXU ops; everything else is layout
 work that XLA folds into surrounding fusions.
 """
+import numpy as np
+
 import jax.numpy as jnp
 
 from .registry import defop
@@ -45,13 +47,28 @@ def reshape(data, shape=(), reverse=False):
         k += 1
     if reverse:
         out = out[::-1]
+    if -1 in out:
+        # resolve the wildcard ourselves: jax's -1 inference divides
+        # by the product of the other dims, which is 0 for zero-size
+        # arrays (found by the degenerate-shape sweep)
+        known = 1
+        for d in out:
+            if d != -1:
+                known *= int(d)
+        total = int(np.prod(data.shape))
+        out[out.index(-1)] = total // known if known > 0 else 0
     return data.reshape(tuple(out))
 
 
 @defop("Flatten", aliases=["flatten"])
 def flatten(data):
-    """Collapse all dims but the first (ref: matrix_op.cc Flatten)."""
-    return data.reshape((data.shape[0], -1))
+    """Collapse all dims but the first (ref: matrix_op.cc Flatten).
+    The trailing size is computed explicitly so zero-size leading
+    dims do not trip -1 inference."""
+    rest = 1
+    for d in data.shape[1:]:
+        rest *= int(d)
+    return data.reshape((data.shape[0], rest))
 
 
 @defop("transpose")

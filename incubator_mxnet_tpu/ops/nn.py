@@ -189,6 +189,13 @@ def upsampling(*args, scale=1, sample_type="nearest", num_filter=0,
 # ---------------------------------------------------------------------------
 
 
+def _stats_cast(x):
+    """Normalization statistics accumulate in float32 for
+    low-precision inputs; no-op at fp32 and above."""
+    return x.astype(jnp.float32) \
+        if x.dtype in (jnp.bfloat16, jnp.float16) else x
+
+
 @defop("BatchNorm", aliases=["BatchNorm_v1", "CuDNNBatchNorm"],
        needs_mode=True, num_aux=2,
        arg_names=["data", "gamma", "beta"],
@@ -202,7 +209,8 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     Functional aux protocol: in training mode returns
     (out, new_moving_mean, new_moving_var); the frontend writes the
     updated stats back into the aux arrays (jit-safe replacement for
-    the reference's in-place aux mutation).
+    the reference's in-place aux mutation).  Batch statistics
+    accumulate in float32 for low-precision inputs (see layer_norm).
     """
     ax = int(axis) % data.ndim
     red = tuple(i for i in range(data.ndim) if i != ax)
@@ -210,8 +218,9 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     bshape[ax] = data.shape[ax]
     g = jnp.ones_like(gamma) if fix_gamma else gamma
     if _training and not use_global_stats:
-        mean = jnp.mean(data, axis=red)
-        var = jnp.var(data, axis=red)
+        xs = _stats_cast(data)
+        mean = jnp.mean(xs, axis=red).astype(moving_mean.dtype)
+        var = jnp.var(xs, axis=red).astype(moving_var.dtype)
         new_mean = (momentum * moving_mean
                     + (1 - momentum) * jax.lax.stop_gradient(mean))
         new_var = (momentum * moving_var
@@ -222,33 +231,42 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     inv = jax.lax.rsqrt(var + eps)
     out = ((data - mean.reshape(bshape)) * inv.reshape(bshape)
            * g.reshape(bshape) + beta.reshape(bshape))
-    if _training:
+    out = out.astype(data.dtype)   # fp32 stats must not upcast the
+    if _training:                  # activation stream
         return out, new_mean, new_var
     return out
 
 
 @defop("LayerNorm")
 def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
-    """Layer normalization over ``axis``."""
+    """Layer normalization over ``axis``.  Statistics accumulate in
+    float32 for low-precision inputs (bf16's 8-bit mantissa loses the
+    mean; the TPU recipe keeps stats fp32, XLA fuses the converts)."""
     ax = int(axis) % data.ndim
-    mean = jnp.mean(data, axis=ax, keepdims=True)
-    var = jnp.var(data, axis=ax, keepdims=True)
+    x = _stats_cast(data)
+    mean = jnp.mean(x, axis=ax, keepdims=True)
+    var = jnp.var(x, axis=ax, keepdims=True)
     shape = [1] * data.ndim
     shape[ax] = data.shape[ax]
-    out = ((data - mean) * jax.lax.rsqrt(var + eps)
-           * gamma.reshape(shape) + beta.reshape(shape))
-    return out
+    out = ((x - mean) * jax.lax.rsqrt(var + eps)
+           * _stats_cast(gamma).reshape(shape)
+           + _stats_cast(beta).reshape(shape))
+    return out.astype(data.dtype)
 
 
 @defop("InstanceNorm")
 def instance_norm(data, gamma, beta, eps=1e-3):
-    """Instance norm over spatial dims (ref: instance_norm.cc)."""
+    """Instance norm over spatial dims (ref: instance_norm.cc);
+    fp32 statistics for low-precision inputs (see layer_norm)."""
     red = tuple(range(2, data.ndim))
-    mean = jnp.mean(data, axis=red, keepdims=True)
-    var = jnp.var(data, axis=red, keepdims=True)
+    x = _stats_cast(data)
+    mean = jnp.mean(x, axis=red, keepdims=True)
+    var = jnp.var(x, axis=red, keepdims=True)
     shape = (1, -1) + (1,) * (data.ndim - 2)
-    return ((data - mean) * jax.lax.rsqrt(var + eps)
-            * gamma.reshape(shape) + beta.reshape(shape))
+    out = ((x - mean) * jax.lax.rsqrt(var + eps)
+           * _stats_cast(gamma).reshape(shape)
+           + _stats_cast(beta).reshape(shape))
+    return out.astype(data.dtype)
 
 
 @defop("L2Normalization")

@@ -134,19 +134,36 @@ def register(name, fn, *, vjp=None, arg_names=None,
     if vjp is not None:
         vjp_fwd, vjp_bwd = vjp
         base = fn
+        # static-param defaults come from the fwd rule's signature, so
+        # the bwd rule sees the SAME param values whether the caller
+        # passed them or relied on defaults
+        import inspect
+        try:
+            fwd_defaults = {
+                p.name: p.default
+                for p in inspect.signature(vjp_fwd).parameters.values()
+                if p.default is not p.empty}
+        except (TypeError, ValueError):
+            fwd_defaults = {}
+        vjp_cache = {}   # params-tuple -> custom_vjp fn (trace cache)
 
         @functools.wraps(fn)
         def fn(*arrays, **params):  # noqa: F811 — deliberate rewrap
-            keys = sorted(params)
+            full = {**fwd_defaults, **params}
+            key = tuple(sorted(full.items()))
+            inner = vjp_cache.get(key)
+            if inner is None:
+                keys = sorted(full)
 
-            @jax.custom_vjp
-            def inner(*t):
-                return base(*t, **params)
+                @jax.custom_vjp
+                def inner(*t):
+                    return base(*t, **full)
 
-            inner.defvjp(
-                lambda *t: vjp_fwd(*t, **params),
-                lambda res, g: tuple(
-                    vjp_bwd(*(params[k] for k in keys), res, g)))
+                inner.defvjp(
+                    lambda *t: vjp_fwd(*t, **full),
+                    lambda res, g: tuple(
+                        vjp_bwd(*(full[k] for k in keys), res, g)))
+                vjp_cache[key] = inner
             return inner(*arrays)
 
         if differentiable is None:
@@ -172,9 +189,12 @@ def register(name, fn, *, vjp=None, arg_names=None,
                arg_names=arg_names, differentiable=differentiable,
                **opdef_kwargs)
     OPS[name] = op
+    ndf = _attach_frontends(name, op)
     for a in aliases:
         OPS[a] = op
-    return _attach_frontends(name, op)
+        _attach_frontends(a, op)
+    _RTC_ALIASES[name] = tuple(aliases)
+    return ndf
 
 
 def _attach_frontends(name, op):
@@ -197,13 +217,18 @@ def _attach_frontends(name, op):
     return ndf
 
 
+_RTC_ALIASES = {}    # primary name -> aliases, for unregister
+
+
 def unregister(name):
-    """Remove a custom op registered by :func:`register` (testing)."""
-    OPS.pop(name, None)
+    """Remove a custom op registered by :func:`register` — including
+    its aliases (testing / re-registration)."""
     from . import ndarray as nd_mod
     from . import symbol as sym_mod
-    for mod in (nd_mod, sym_mod):
-        target = mod._internal if name.startswith("_") and \
-            hasattr(mod, "_internal") else mod
-        if hasattr(target, name):
-            delattr(target, name)
+    for n in (name,) + _RTC_ALIASES.pop(name, ()):
+        OPS.pop(n, None)
+        for mod in (nd_mod, sym_mod):
+            target = mod._internal if n.startswith("_") and \
+                hasattr(mod, "_internal") else mod
+            if hasattr(target, n):
+                delattr(target, n)

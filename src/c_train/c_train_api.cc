@@ -1,0 +1,412 @@
+/*
+ * Native training ABI over the framework's Module API (see
+ * c_train_api.h; ref role: cpp-package/include/mxnet-cpp/MxNetCpp.h).
+ * Embeds CPython like ../c_predict: the XLA-compiled fused
+ * fwd+bwd+update IS the native fast path; this layer only marshals
+ * buffers and steps the executable.
+ *
+ * Threading model: every entry point takes the GIL via
+ * PyGILState_Ensure, so C clients may call from any thread.
+ */
+#include "c_train_api.h"
+
+#include <Python.h>
+
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_last_error;
+
+const char *kGlueSource = R"PY(
+import os
+import tempfile
+
+import numpy as np
+
+try:
+    from incubator_mxnet_tpu.utils.platform import maybe_force_cpu
+    maybe_force_cpu()
+except Exception:
+    pass
+
+
+class _CTrain(object):
+    def __init__(self, sym_json, param_bytes, shapes, dev_type,
+                 dev_id, optimizer, lr):
+        import incubator_mxnet_tpu as mx
+        from incubator_mxnet_tpu.io.io import DataDesc
+        ctx = mx.cpu(dev_id) if dev_type == 1 else mx.tpu(dev_id)
+        sym = mx.sym.load_json(sym_json)
+        args = set(sym.list_arguments())
+        unknown = [k for k in shapes if k not in args]
+        if unknown:
+            raise ValueError(
+                "input keys %r are not arguments of the symbol (%r)"
+                % (unknown, sorted(args)))
+        self._data_names = [k for k in shapes
+                            if not k.endswith("_label")]
+        self._label_names = [k for k in shapes
+                             if k.endswith("_label")]
+        self._mod = mx.mod.Module(
+            sym, data_names=self._data_names,
+            label_names=self._label_names, context=ctx)
+        self._mod.bind(
+            data_shapes=[DataDesc(k, shapes[k])
+                         for k in self._data_names],
+            label_shapes=[DataDesc(k, shapes[k])
+                          for k in self._label_names] or None,
+            for_training=True)
+        if param_bytes:
+            from incubator_mxnet_tpu.model import split_tagged_params
+            f = tempfile.NamedTemporaryFile(delete=False,
+                                            suffix=".params")
+            try:
+                f.write(param_bytes)
+                f.close()
+                arg_p, aux_p = split_tagged_params(
+                    mx.nd.load(f.name))
+            finally:
+                os.unlink(f.name)
+            self._mod.init_params(arg_params=arg_p, aux_params=aux_p,
+                                  allow_missing=False)
+        else:
+            self._mod.init_params(mx.initializer.Xavier())
+        self._mod.init_optimizer(
+            optimizer=optimizer,
+            optimizer_params=dict(learning_rate=lr))
+        self._shapes = {k: tuple(int(d) for d in v)
+                        for k, v in shapes.items()}
+        self._bufs = {}
+        self._params_blob = b""
+
+    def set_input(self, key, mv, size):
+        shape = self._shapes[key]
+        arr = np.frombuffer(mv, dtype=np.float32, count=size)
+        self._bufs[key] = arr.reshape(shape).copy()
+
+    def _batch(self):
+        import incubator_mxnet_tpu as mx
+        from incubator_mxnet_tpu.io.io import DataBatch
+        missing = [k for k in self._data_names + self._label_names
+                   if k not in self._bufs]
+        if missing:
+            raise ValueError("inputs %r not set" % (missing,))
+        return DataBatch(
+            [mx.nd.array(self._bufs[k]) for k in self._data_names],
+            [mx.nd.array(self._bufs[k]) for k in self._label_names])
+
+    def step(self):
+        self._mod.forward_backward(self._batch())
+        self._mod.update()
+        return self._loss()
+
+    def _loss(self):
+        out = self._mod.get_outputs()[0].asnumpy() \
+            .astype(np.float64)
+        if self._label_names and out.ndim == 2 and \
+                np.allclose(out.sum(axis=1), 1.0, atol=1e-3):
+            # softmax-style head: mean cross-entropy vs first label
+            y = self._bufs[self._label_names[0]].astype(int).ravel()
+            p = out[np.arange(out.shape[0]), y]
+            return float(-np.log(np.clip(p, 1e-12, None)).mean())
+        return float(out.mean())
+
+    def forward(self):
+        self._mod.forward(self._batch(), is_train=False)
+
+    def output_shape(self, index):
+        return tuple(int(d) for d in
+                     self._mod.get_outputs()[index].shape)
+
+    def read_output(self, index, mv, size):
+        out = np.asarray(
+            self._mod.get_outputs()[index].asnumpy(),
+            dtype=np.float32).ravel()
+        if out.size != size:
+            raise ValueError(
+                "output %d has %d elements, caller buffer holds %d"
+                % (index, out.size, size))
+        dst = np.frombuffer(mv, dtype=np.float32, count=size)
+        dst[:] = out
+
+    def get_params(self):
+        import incubator_mxnet_tpu as mx
+        arg_p, aux_p = self._mod.get_params()
+        save = {"arg:%s" % k: v for k, v in arg_p.items()}
+        save.update({"aux:%s" % k: v for k, v in aux_p.items()})
+        f = tempfile.NamedTemporaryFile(delete=False,
+                                        suffix=".params")
+        try:
+            f.close()
+            mx.nd.save(f.name, save)
+            with open(f.name, "rb") as r:
+                self._params_blob = r.read()
+        finally:
+            os.unlink(f.name)
+        return self._params_blob
+)PY";
+
+PyObject *g_glue_ns = nullptr;
+bool g_owns_interpreter = false;
+
+struct TrainHandle {
+  PyObject *obj;                  /* _CTrain instance */
+  std::vector<mx_uint> shape;     /* last queried output shape */
+  std::string params;             /* last serialized params */
+};
+
+class GIL {
+ public:
+  GIL() : state_(PyGILState_Ensure()) {}
+  ~GIL() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  g_last_error = "unknown python error";
+  if (value != nullptr) {
+    PyObject *s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char *c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) g_last_error = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+int ensure_runtime() {
+  static std::mutex mu;
+  std::lock_guard<std::mutex> lock(mu);
+  if (g_glue_ns != nullptr) return 0;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    g_owns_interpreter = true;
+    PyEval_SaveThread();
+  }
+  GIL gil;
+  PyObject *ns = PyDict_New();
+  if (ns == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  PyDict_SetItemString(ns, "__builtins__", PyEval_GetBuiltins());
+  PyObject *r = PyRun_String(kGlueSource, Py_file_input, ns, ns);
+  if (r == nullptr) {
+    set_error_from_python();
+    Py_DECREF(ns);
+    return -1;
+  }
+  Py_DECREF(r);
+  g_glue_ns = ns;
+  return 0;
+}
+
+PyObject *shapes_dict(mx_uint num, const char **keys,
+                      const mx_uint *indptr, const mx_uint *data) {
+  PyObject *d = PyDict_New();
+  if (d == nullptr) return nullptr;
+  for (mx_uint i = 0; i < num; ++i) {
+    mx_uint ndim = indptr[i + 1] - indptr[i];
+    PyObject *t = PyTuple_New(ndim);
+    for (mx_uint j = 0; j < ndim; ++j) {
+      PyTuple_SET_ITEM(
+          t, j, PyLong_FromUnsignedLong(data[indptr[i] + j]));
+    }
+    if (PyDict_SetItemString(d, keys[i], t) != 0) {
+      Py_DECREF(t);
+      Py_DECREF(d);
+      return nullptr;
+    }
+    Py_DECREF(t);
+  }
+  return d;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char *MXTPUTrainGetLastError(void) {
+  return g_last_error.c_str();
+}
+
+int MXTPUTrainCreate(const char *symbol_json, const void *param_bytes,
+                     int param_size, int dev_type, int dev_id,
+                     mx_uint num_inputs, const char **input_keys,
+                     const mx_uint *input_shape_indptr,
+                     const mx_uint *input_shape_data,
+                     const char *optimizer, float learning_rate,
+                     TrainerHandle *out) {
+  if (ensure_runtime() != 0) return -1;
+  GIL gil;
+  PyObject *shapes = shapes_dict(num_inputs, input_keys,
+                                 input_shape_indptr,
+                                 input_shape_data);
+  if (shapes == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject *cls = PyDict_GetItemString(g_glue_ns, "_CTrain");
+  PyObject *bytes =
+      param_bytes == nullptr || param_size <= 0
+          ? PyBytes_FromStringAndSize("", 0)
+          : PyBytes_FromStringAndSize(
+                static_cast<const char *>(param_bytes), param_size);
+  PyObject *obj =
+      bytes == nullptr
+          ? nullptr
+          : PyObject_CallFunction(cls, "sOOiisf", symbol_json, bytes,
+                                  shapes, dev_type, dev_id, optimizer,
+                                  static_cast<double>(learning_rate));
+  Py_XDECREF(bytes);
+  Py_DECREF(shapes);
+  if (obj == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  auto *h = new TrainHandle();
+  h->obj = obj;
+  *out = h;
+  return 0;
+}
+
+int MXTPUTrainSetInput(TrainerHandle handle, const char *key,
+                       const float *data, mx_uint size) {
+  auto *h = static_cast<TrainHandle *>(handle);
+  GIL gil;
+  PyObject *mv = PyMemoryView_FromMemory(
+      reinterpret_cast<char *>(const_cast<float *>(data)),
+      static_cast<Py_ssize_t>(size) * sizeof(float), PyBUF_READ);
+  PyObject *r =
+      mv == nullptr
+          ? nullptr
+          : PyObject_CallMethod(h->obj, "set_input", "sOI", key, mv,
+                                size);
+  Py_XDECREF(mv);
+  if (r == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPUTrainStep(TrainerHandle handle, float *loss) {
+  auto *h = static_cast<TrainHandle *>(handle);
+  GIL gil;
+  PyObject *r = PyObject_CallMethod(h->obj, "step", nullptr);
+  if (r == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  if (loss != nullptr) *loss = static_cast<float>(PyFloat_AsDouble(r));
+  Py_DECREF(r);
+  if (PyErr_Occurred()) {
+    set_error_from_python();
+    return -1;
+  }
+  return 0;
+}
+
+int MXTPUTrainForward(TrainerHandle handle) {
+  auto *h = static_cast<TrainHandle *>(handle);
+  GIL gil;
+  PyObject *r = PyObject_CallMethod(h->obj, "forward", nullptr);
+  if (r == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPUTrainGetOutputShape(TrainerHandle handle, mx_uint index,
+                             mx_uint **shape_data,
+                             mx_uint *shape_ndim) {
+  auto *h = static_cast<TrainHandle *>(handle);
+  GIL gil;
+  PyObject *r =
+      PyObject_CallMethod(h->obj, "output_shape", "I", index);
+  if (r == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  h->shape.clear();
+  Py_ssize_t n = PyTuple_Size(r);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    h->shape.push_back(static_cast<mx_uint>(
+        PyLong_AsUnsignedLong(PyTuple_GetItem(r, i))));
+  }
+  Py_DECREF(r);
+  *shape_data = h->shape.data();
+  *shape_ndim = static_cast<mx_uint>(h->shape.size());
+  return 0;
+}
+
+int MXTPUTrainGetOutput(TrainerHandle handle, mx_uint index,
+                        float *data, mx_uint size) {
+  auto *h = static_cast<TrainHandle *>(handle);
+  GIL gil;
+  PyObject *mv = PyMemoryView_FromMemory(
+      reinterpret_cast<char *>(data),
+      static_cast<Py_ssize_t>(size) * sizeof(float), PyBUF_WRITE);
+  PyObject *r =
+      mv == nullptr
+          ? nullptr
+          : PyObject_CallMethod(h->obj, "read_output", "IOI", index,
+                                mv, size);
+  Py_XDECREF(mv);
+  if (r == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPUTrainGetParams(TrainerHandle handle, const void **bytes,
+                        int *size) {
+  auto *h = static_cast<TrainHandle *>(handle);
+  GIL gil;
+  PyObject *r = PyObject_CallMethod(h->obj, "get_params", nullptr);
+  if (r == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  char *buf = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(r, &buf, &n) != 0) {
+    set_error_from_python();
+    Py_DECREF(r);
+    return -1;
+  }
+  h->params.assign(buf, static_cast<size_t>(n));
+  Py_DECREF(r);
+  *bytes = h->params.data();
+  *size = static_cast<int>(h->params.size());
+  return 0;
+}
+
+int MXTPUTrainFree(TrainerHandle handle) {
+  auto *h = static_cast<TrainHandle *>(handle);
+  {
+    GIL gil;
+    Py_XDECREF(h->obj);
+  }
+  delete h;
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,86 @@
+/*
+ * C training ABI (ref role: cpp-package/include/mxnet-cpp/MxNetCpp.h —
+ * the C++ training surface over NDArray/Symbol/Executor/KVStore;
+ * 8.5k LoC of wrappers in the reference).
+ *
+ * This is NOT a port: it is a minimal, fresh training ABI over the
+ * TPU framework's Module API, embedding the interpreter in the host
+ * process exactly like the predict ABI (../c_predict).  A C/C++
+ * client links libmxtpu_train.so, feeds batches, steps the
+ * compiled fwd+bwd+update executable, and reads back loss, outputs
+ * and trained parameters (bytes loadable by MXTPUPredCreate for
+ * deployment).
+ *
+ * Device types: 1 = cpu, 2 = tpu.
+ * All functions return 0 on success, -1 on failure; call
+ * MXTPUTrainGetLastError() for the message.
+ */
+#ifndef MXTPU_C_TRAIN_API_H_
+#define MXTPU_C_TRAIN_API_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef unsigned int mx_uint;
+typedef void *TrainerHandle;
+
+/* Human-readable message for the last failed call in this thread. */
+const char *MXTPUTrainGetLastError(void);
+
+/* Create a trainer from a symbol JSON (ending in a loss head such
+ * as SoftmaxOutput / LinearRegressionOutput):
+ *   param_bytes/param_size : optional initial params (arg:/aux:
+ *     tagged, the predict-ABI format); pass NULL/0 for fresh
+ *     Xavier initialization
+ *   num_inputs, input_keys : data AND label inputs ("data",
+ *     "softmax_label", ...)
+ *   input_shape_indptr/input_shape_data : CSR-packed shapes
+ *   optimizer : "sgd", "adam", ... ; learning_rate applies to it
+ */
+int MXTPUTrainCreate(const char *symbol_json, const void *param_bytes,
+                     int param_size, int dev_type, int dev_id,
+                     mx_uint num_inputs, const char **input_keys,
+                     const mx_uint *input_shape_indptr,
+                     const mx_uint *input_shape_data,
+                     const char *optimizer, float learning_rate,
+                     TrainerHandle *out);
+
+/* Copy `size` floats into the named input (data or label). */
+int MXTPUTrainSetInput(TrainerHandle handle, const char *key,
+                       const float *data, mx_uint size);
+
+/* One training step on the current inputs: fused forward+backward+
+ * optimizer update (one XLA executable after the first call).
+ * *loss receives the mean loss (cross-entropy for softmax-style
+ * heads, mean head output otherwise). */
+int MXTPUTrainStep(TrainerHandle handle, float *loss);
+
+/* Forward only (evaluation) on the current inputs. */
+int MXTPUTrainForward(TrainerHandle handle);
+
+/* Shape of output `index`; pointers valid until the next call on
+ * this handle. */
+int MXTPUTrainGetOutputShape(TrainerHandle handle, mx_uint index,
+                             mx_uint **shape_data,
+                             mx_uint *shape_ndim);
+
+/* Copy output `index` (float32) into caller memory of `size`
+ * floats. */
+int MXTPUTrainGetOutput(TrainerHandle handle, mx_uint index,
+                        float *data, mx_uint size);
+
+/* Serialized trained parameters (arg:/aux: tagged bytes — the same
+ * format MXTPUPredCreate consumes).  The buffer belongs to the
+ * handle and is valid until the next MXTPUTrainGetParams or Free. */
+int MXTPUTrainGetParams(TrainerHandle handle, const void **bytes,
+                        int *size);
+
+/* Release the trainer. */
+int MXTPUTrainFree(TrainerHandle handle);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* MXTPU_C_TRAIN_API_H_ */

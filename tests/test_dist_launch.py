@@ -74,3 +74,29 @@ def test_launch_elastic_restart(tmp_path):
          "-n", "2", "--", sys.executable, "-c", script],
         capture_output=True, text=True, timeout=120, env=env, cwd=repo)
     assert r.returncode == 9
+
+
+def test_elastic_resume_from_checkpoint(tmp_path):
+    """The full elasticity claim (SURVEY §5): kill rank 1 mid-train,
+    --max-restarts relaunches the job, workers resume from the last
+    checkpoint (not epoch 0) and converge."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    env["MXTPU_ELASTIC_DIR"] = str(tmp_path)
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "launch.py"),
+         "-n", "2", "--max-restarts", "2", "--", sys.executable,
+         os.path.join(repo, "tests", "dist_elastic_worker.py")],
+        capture_output=True, text=True, timeout=600, env=env,
+        cwd=repo)
+    out = r.stdout + r.stderr
+    assert r.returncode == 0, out[-3000:]
+    assert "CRASHING rank 1 after epoch 1" in out, out[-3000:]
+    assert "restarting job (attempt 1/2)" in out, out[-3000:]
+    # resumed from the epoch-2 checkpoint, not from scratch
+    assert "RESUMED_FROM 2 rank 0" in out, out[-3000:]
+    assert "RESUMED_FROM 2 rank 1" in out, out[-3000:]
+    assert "ELASTIC_OK rank 0 attempt 1" in out, out[-3000:]
+    assert "ELASTIC_OK rank 1 attempt 1" in out, out[-3000:]

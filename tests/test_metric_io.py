@@ -230,3 +230,86 @@ def test_wd_default_rule_matches_reference():
     assert opt._get_wd(0) == 0.5   # gamma IS decayed in reference
     assert opt._get_wd(1) == 0.0   # bias exempt
     assert opt._get_wd(2) == 0.0   # non-weight/gamma exempt
+
+
+def test_device_prefetch_iter_matches_and_commits():
+    """DevicePrefetchIter (src/io/iter_prefetcher.h:47 analog) yields
+    the same batches as its inner iterator, device-committed."""
+    import jax
+
+    import incubator_mxnet_tpu as mx
+
+    x = np.arange(48, dtype=np.float32).reshape(12, 4)
+    y = np.arange(12, dtype=np.float32)
+    inner = mx.io.NDArrayIter(x, y, batch_size=4)
+    pre = mx.io.DevicePrefetchIter(
+        mx.io.NDArrayIter(x, y, batch_size=4), ctx=mx.cpu(3))
+    got = list(pre)
+    want = list(inner)
+    assert len(got) == len(want) == 3
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g.data[0].asnumpy(),
+                                      w.data[0].asnumpy())
+        np.testing.assert_array_equal(g.label[0].asnumpy(),
+                                      w.label[0].asnumpy())
+        assert g.data[0]._data.devices() == \
+            {mx.cpu(3).jax_device}
+    # reset restarts cleanly and yields a full epoch again
+    pre.reset()
+    assert sum(b.data[0].shape[0] for b in pre) == 12
+    assert pre.provide_data[0].shape == (4, 4)
+
+
+def test_device_prefetch_iter_propagates_worker_error():
+    import incubator_mxnet_tpu as mx
+
+    class Boom(mx.io.DataIter):
+        batch_size = 2
+
+        def next(self):
+            raise RuntimeError("decode exploded")
+
+        @property
+        def provide_data(self):
+            return []
+
+        @property
+        def provide_label(self):
+            return []
+
+    pre = mx.io.DevicePrefetchIter(Boom(), ctx=mx.cpu(0))
+    with pytest.raises(RuntimeError, match="decode exploded"):
+        pre.next()
+
+
+def test_device_prefetch_iter_terminal_states_do_not_block():
+    """Repeated next() after exhaustion / error must re-raise, not
+    block on a producerless queue (review regression)."""
+    import incubator_mxnet_tpu as mx
+
+    x = np.arange(8, dtype=np.float32).reshape(4, 2)
+    pre = mx.io.DevicePrefetchIter(
+        mx.io.NDArrayIter(x, None, batch_size=2), ctx=mx.cpu(0))
+    assert len(list(pre)) == 2
+    for _ in range(3):
+        with pytest.raises(StopIteration):
+            pre.next()
+
+    class Boom(mx.io.DataIter):
+        batch_size = 2
+
+        def next(self):
+            raise RuntimeError("decode exploded")
+
+        @property
+        def provide_data(self):
+            return []
+
+        @property
+        def provide_label(self):
+            return []
+
+    pre = mx.io.DevicePrefetchIter(Boom(), ctx=mx.cpu(0))
+    for _ in range(2):
+        with pytest.raises(RuntimeError, match="decode exploded"):
+            pre.next()

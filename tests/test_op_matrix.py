@@ -1,0 +1,270 @@
+"""Deep operator test matrix (round-3 verdict task 7): the reference's
+test_operator.py discipline (ref: tests/python/unittest/
+test_operator.py:1 — dtype matrices, conv stride/dilate/group sweeps,
+degenerate shapes) applied registry-wide.
+
+Three axes beyond tests/test_op_sweep.py's one-shape fp32 pass:
+
+1. bf16: every swept op's forward must agree with its fp32 forward to
+   bf16 tolerance (the TPU compute dtype; a hard-coded float32 or an
+   accumulation bug shows up here).
+2. structured-op parameter matrices: conv stride/dilate/groups/pad/1x1
+   and 1d/3d, pooling type/pad/overlap/global/full-convention — each
+   numeric-gradient checked.
+3. degenerate shapes: size-0 and size-1 dims through elemwise,
+   broadcast, reduction, and concat ops against the numpy oracle.
+"""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu import test_utils as tu
+from incubator_mxnet_tpu.ops.registry import OPS
+
+from test_op_sweep import CASES, P
+
+RS = np.random.RandomState(11)
+
+
+# ---------------------------------------------------------------- bf16
+# ops whose spec'd inputs leave the bf16-safe domain or that are
+# numerically dtype-bound (linalg factorizations need fp32 pivots;
+# erfinv/digamma-family curvature amplifies bf16 input rounding)
+BF16_SKIP = {
+    "linalg_potrf", "linalg_potri", "linalg_trsm", "linalg_gelqf",
+    "linalg_syevd", "linalg_sumlogdiag", "linalg_extractdiag",
+    "linalg_makediag", "linalg_extracttrian", "linalg_maketrian",
+    "erfinv", "_power_scalar", "_rpower_scalar", "reciprocal",
+    "rcbrt", "rsqrt", "_rdiv_scalar", "gammaln", "gamma",
+    "GridGenerator", "BilinearSampler", "SpatialTransformer",
+    "Correlation", "_flash_attention",
+    # index-valued outputs: bf16 input rounding creates ties, so the
+    # winning index can legitimately differ from fp32's
+    "topk", "argmax", "argmin", "argmax_channel", "argsort",
+}
+
+
+def _float_outputs(res):
+    # bfloat16's numpy dtype (ml_dtypes) is not np.floating; anything
+    # that is not int/uint/bool counts as float here
+    outs = res if isinstance(res, list) else [res]
+    return [o for o in outs if np.dtype(o.dtype).kind not in "iub"]
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_bf16_forward_matches_fp32(name):
+    if name in BF16_SKIP:
+        pytest.skip("documented bf16-unsafe domain")
+    spec = CASES[name]
+    fn = getattr(nd, name, None) or getattr(nd._internal, name)
+    params = spec.get("params", {})
+    ins32 = [nd.array(v) for v in spec["inputs"]]
+    ins16 = [x.astype("bfloat16")
+             if np.issubdtype(x.dtype, np.floating) else x
+             for x in ins32]
+    out32 = _float_outputs(fn(*ins32, **params))
+    out16 = _float_outputs(fn(*ins16, **params))
+    assert len(out32) == len(out16)
+    for a32, a16 in zip(out32, out16):
+        ref = a32.asnumpy().astype(np.float64)
+        got = a16.astype("float32").asnumpy().astype(np.float64)
+        # bf16 mantissa is 8 bits: 1/128 per-op relative error, with
+        # headroom for accumulation across a reduced axis
+        scale = np.maximum(np.abs(ref), 1e-2)
+        assert np.all(np.abs(got - ref) <= 0.06 * scale + 0.02), (
+            name, float(np.max(np.abs(got - ref) / scale)))
+
+
+def test_bf16_coverage_not_vacuous():
+    covered = [n for n in CASES if n not in BF16_SKIP]
+    assert len(covered) >= 150, len(covered)
+
+
+# ------------------------------------------------- conv/pool matrices
+CONV_CONFIGS = {
+    "stride2": dict(kernel=(3, 3), stride=(2, 2), num_filter=3),
+    "dilate2": dict(kernel=(3, 3), dilate=(2, 2), num_filter=3),
+    "pad1": dict(kernel=(3, 3), pad=(1, 1), num_filter=3),
+    "groups2": dict(kernel=(3, 3), num_group=2, num_filter=4),
+    "k1x1": dict(kernel=(1, 1), num_filter=3),
+    "rect": dict(kernel=(3, 1), stride=(2, 1), num_filter=3),
+    "full": dict(kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                 dilate=(2, 2), num_filter=3),
+}
+
+
+@pytest.mark.parametrize("cfg", sorted(CONV_CONFIGS))
+def test_conv2d_matrix(cfg):
+    params = dict(CONV_CONFIGS[cfg])
+    cin = 4 if params.get("num_group") else 2
+    cout = params["num_filter"]
+    kh, kw = params["kernel"]
+    wshape = (cout, cin // params.get("num_group", 1), kh, kw)
+    data, w, b = mx.sym.Variable("data"), mx.sym.Variable("w"), \
+        mx.sym.Variable("b")
+    sym = mx.sym.Convolution(data, w, b, **params)
+    loc = {"data": P(2, cin, 7, 7), "w": P(*wshape), "b": P(cout)}
+    tu.check_numeric_gradient(sym, loc, numeric_eps=1e-3, rtol=0.08,
+                              atol=5e-3)
+
+
+@pytest.mark.parametrize("ndim", [1, 3])
+def test_conv_1d_3d(ndim):
+    sp = (5,) * ndim
+    k = (3,) * ndim
+    data, w = mx.sym.Variable("data"), mx.sym.Variable("w")
+    sym = mx.sym.Convolution(data, w, kernel=k, num_filter=2,
+                             stride=(2,) * ndim, no_bias=True)
+    loc = {"data": P(1, 2, *sp), "w": P(2, 2, *k)}
+    tu.check_numeric_gradient(sym, loc, numeric_eps=1e-3, rtol=0.08,
+                              atol=5e-3)
+
+
+POOL_CONFIGS = {
+    "max_basic": dict(kernel=(2, 2), stride=(2, 2), pool_type="max"),
+    "avg_pad": dict(kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                    pool_type="avg"),
+    "max_overlap": dict(kernel=(3, 3), stride=(1, 1),
+                        pool_type="max"),
+    "avg_global": dict(kernel=(2, 2), global_pool=True,
+                       pool_type="avg"),
+    "max_full_conv": dict(kernel=(3, 3), stride=(2, 2),
+                          pooling_convention="full",
+                          pool_type="max"),
+    "sum_pool": dict(kernel=(2, 2), stride=(2, 2), pool_type="sum"),
+}
+
+
+@pytest.mark.parametrize("cfg", sorted(POOL_CONFIGS))
+def test_pooling_matrix(cfg):
+    params = dict(POOL_CONFIGS[cfg])
+    data = mx.sym.Variable("data")
+    sym = mx.sym.Pooling(data, **params)
+    # distinct values keep max-pool numeric grads off ties
+    x = np.linspace(0.1, 0.9, 2 * 2 * 6 * 6, dtype=np.float32) \
+        .reshape(2, 2, 6, 6)
+    x += RS.rand(2, 2, 6, 6).astype(np.float32) * 1e-3
+    tu.check_numeric_gradient(sym, {"data": x}, numeric_eps=1e-4,
+                              rtol=0.08, atol=5e-3)
+
+
+# --------------------------------------------- degenerate-shape oracle
+BROADCAST_SHAPES = [
+    ((1, 1), (3, 4)),
+    ((2, 1, 4), (1, 3, 1)),
+    ((1,), (2, 3)),
+    ((0, 3), (0, 3)),
+    ((1, 3), (0, 1, 3)),
+    ((5, 1), (1, 1)),
+]
+BROADCAST_OPS = {
+    "broadcast_add": np.add,
+    "broadcast_sub": np.subtract,
+    "broadcast_mul": np.multiply,
+    "broadcast_div": np.divide,
+    "broadcast_maximum": np.maximum,
+    "broadcast_minimum": np.minimum,
+    "broadcast_power": np.power,
+    "broadcast_hypot": np.hypot,
+}
+
+
+@pytest.mark.parametrize("opname", sorted(BROADCAST_OPS))
+def test_broadcast_edge_shapes(opname):
+    fn = getattr(nd, opname)
+    ref = BROADCAST_OPS[opname]
+    for sa, sb in BROADCAST_SHAPES:
+        a = (RS.rand(*sa) * 0.8 + 0.1).astype(np.float32)
+        b = (RS.rand(*sb) * 0.8 + 0.1).astype(np.float32)
+        got = fn(nd.array(a), nd.array(b)).asnumpy()
+        want = ref(a, b)
+        assert got.shape == want.shape, (opname, sa, sb, got.shape)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6,
+                                   err_msg=f"{opname} {sa}x{sb}")
+
+
+UNARY_EMPTY = ["exp", "log1p", "relu", "sigmoid", "negative", "abs",
+               "square", "tanh", "floor"]
+
+
+@pytest.mark.parametrize("opname", UNARY_EMPTY)
+def test_unary_on_empty_and_singleton(opname):
+    fn = getattr(nd, opname)
+    for shape in [(0, 3), (1, 1), (4, 0, 2)]:
+        x = RS.rand(*shape).astype(np.float32)
+        out = fn(nd.array(x)).asnumpy()
+        assert out.shape == shape
+
+
+def test_reductions_degenerate_axes():
+    # sum over a 0-length axis is 0; over size-1 axes is identity
+    x0 = np.zeros((2, 0, 3), np.float32)
+    np.testing.assert_array_equal(
+        nd.sum(nd.array(x0), axis=1).asnumpy(),
+        np.zeros((2, 3), np.float32))
+    x1 = RS.rand(2, 1, 3).astype(np.float32)
+    np.testing.assert_allclose(
+        nd.sum(nd.array(x1), axis=1).asnumpy(), x1[:, 0, :],
+        rtol=1e-6)
+    np.testing.assert_allclose(
+        nd.prod(nd.array(x0), axis=1).asnumpy(),
+        np.ones((2, 3), np.float32))
+    np.testing.assert_allclose(
+        nd.max(nd.array(x1), axis=1).asnumpy(), x1[:, 0, :],
+        rtol=1e-6)
+
+
+def test_concat_with_empty_part():
+    a = RS.rand(2, 3).astype(np.float32)
+    e = np.zeros((0, 3), np.float32)
+    got = nd.concat(nd.array(a), nd.array(e), dim=0).asnumpy()
+    np.testing.assert_allclose(got, a, rtol=1e-6)
+
+
+def test_reshape_zero_size():
+    x = nd.array(np.zeros((0, 4), np.float32))
+    assert nd.reshape(x, shape=(0, 2, 2)).shape == (0, 2, 2)
+    assert nd.Flatten(x).shape == (0, 4)
+
+
+def test_norm_stats_accumulate_fp32():
+    """LayerNorm/BatchNorm on bf16 inputs must compute statistics in
+    fp32 (found by this sweep: bf16 statistics put LayerNorm ~2e-2
+    off the fp32 oracle; with fp32 stats it lands within bf16 output
+    rounding) while keeping the activation stream bf16."""
+    x = (RS.rand(4, 64).astype(np.float32) - 0.3) * 3
+    g = np.ones(64, np.float32)
+    b = np.zeros(64, np.float32)
+    ref = nd.LayerNorm(nd.array(x), nd.array(g),
+                       nd.array(b)).asnumpy()
+    out = nd.LayerNorm(nd.array(x).astype("bfloat16"),
+                       nd.array(g).astype("bfloat16"),
+                       nd.array(b).astype("bfloat16"))
+    assert str(out.dtype) == "bfloat16"
+    got = out.astype("float32").asnumpy()
+    # bf16 OUTPUT rounding only: 1/128 relative
+    np.testing.assert_allclose(got, ref, rtol=0.02, atol=0.02)
+
+
+def test_block_cast_bf16_with_deferred_init():
+    """net.cast('bfloat16') before the first forward: deferred-init
+    params must materialize bf16 (found by this sweep: the out=
+    rebind in imperative_invoke dropped the target dtype, so Xavier
+    refilled cast weights as fp32 and conv raised a dtype mismatch)."""
+    from incubator_mxnet_tpu import autograd, gluon
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Conv2D(4, 3), gluon.nn.BatchNorm(),
+                gluon.nn.Dense(3))
+    net.initialize(mx.initializer.Xavier())
+    net.cast("bfloat16")
+    x = nd.array(RS.rand(2, 3, 8, 8).astype(np.float32)) \
+        .astype("bfloat16")
+    with autograd.record():
+        out = net(x)
+        loss = out.astype("float32").square().mean()
+    loss.backward()
+    assert str(out.dtype) == "bfloat16"
+    for name, p in net.collect_params().items():
+        assert str(p.data().dtype) == "bfloat16", name

@@ -114,3 +114,49 @@ def test_alias_conflict_leaves_registry_clean():
     # a corrected retry must succeed
     rtc.register("test_rtc_fresh", lambda x: x)
     rtc.unregister("test_rtc_fresh")
+
+
+def test_vjp_uses_defaults_when_params_omitted():
+    """Backward with a defaulted static param: the bwd rule must see
+    the fwd rule's default, not crash on arity (review regression)."""
+    def scale_kernel(x_ref, o_ref, *, alpha):
+        o_ref[...] = x_ref[...] * alpha
+
+    fn = rtc.compile_kernel(
+        scale_kernel,
+        out_shape=lambda x, alpha=2.0: jax.ShapeDtypeStruct(
+            x.shape, x.dtype))
+    rtc.register(
+        "test_rtc_defscale", fn, arg_names=["data"],
+        vjp=(lambda x, alpha=2.0: (fn(x, alpha=alpha), None),
+             lambda alpha, res, g: (g * alpha,)))
+    try:
+        x = nd.array(np.ones((2, 2), np.float32))
+        x.attach_grad()
+        with autograd.record():
+            y = nd.test_rtc_defscale(x)      # alpha omitted -> 2.0
+        y.backward()
+        np.testing.assert_allclose(x.grad.asnumpy(),
+                                   np.full((2, 2), 2.0))
+    finally:
+        rtc.unregister("test_rtc_defscale")
+
+
+def test_aliases_attach_and_unregister():
+    from incubator_mxnet_tpu.ops.registry import OPS
+    rtc.register("test_rtc_primary", lambda x: x * 2,
+                 aliases=("test_rtc_alias",))
+    try:
+        out = nd.test_rtc_alias(nd.array(np.ones(3, np.float32)))
+        np.testing.assert_allclose(out.asnumpy(), 2.0)
+        s = mx.sym.test_rtc_alias(mx.sym.Variable("x"))
+        assert s is not None
+    finally:
+        rtc.unregister("test_rtc_primary")
+    assert "test_rtc_primary" not in OPS
+    assert "test_rtc_alias" not in OPS
+    assert not hasattr(nd, "test_rtc_alias")
+    # full re-registration under both names succeeds
+    rtc.register("test_rtc_primary", lambda x: x,
+                 aliases=("test_rtc_alias",))
+    rtc.unregister("test_rtc_primary")

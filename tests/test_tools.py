@@ -83,3 +83,23 @@ def test_bandwidth_tool_collectives_and_kvstore():
     assert kv["payload_mb"] > 10 and kv["gbps"] > 0
     h2d = by_bench["h2d"][0]
     assert h2d["h2d_gbps"] > 0 and h2d["d2h_gbps"] > 0
+
+
+def test_pipeline_bench_mode(tmp_path):
+    """bench.py's pipeline mode: .rec decode -> DevicePrefetchIter ->
+    train step, end-to-end on the CPU backend with the tiny net."""
+    import json
+    env = dict(os.environ)
+    env.update(MXTPU_BENCH_PLATFORM="cpu", MXTPU_BENCH_MODEL="pipeline",
+               MXTPU_BENCH_PIPE_IMGS="64", MXTPU_BENCH_PIPE_NET="tiny",
+               MXTPU_BENCH_BATCH="16")
+    env.pop("XLA_FLAGS", None)
+    repo = os.path.dirname(TOOLS)
+    r = subprocess.run([sys.executable, os.path.join(repo, "bench.py")],
+                       capture_output=True, text=True, timeout=420,
+                       env=env, cwd=repo)
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["metric"].startswith("tiny_e2e_pipeline")
+    assert rec["value"] > 0 and rec["feed_only_img_s"] > 0
+    assert rec["naked_step_img_s"] > 0 and rec["e2e_over_step"] > 0
