@@ -218,9 +218,31 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     bshape[ax] = data.shape[ax]
     g = jnp.ones_like(gamma) if fix_gamma else gamma
     if _training and not use_global_stats:
+        # single-pass statistics: both channel reductions in ONE
+        # sweep of the activation through HBM, vs jnp.var's
+        # mean -> (x-mean)^2 second pass.  BN statistics are ~30% of
+        # the ResNet-50 step device time (PERF.md
+        # multiply_reduce_fusion row) and the workload is HBM-bound,
+        # so halving the stat passes is the lever.  The sums are over
+        # x - x0 with x0 one sample per channel (the textbook shifted
+        # algorithm): E[(x-x0)^2] - E[x-x0]^2 is algebraically the
+        # same variance but the raw E[x^2]-E[x]^2 form cancels
+        # catastrophically when mean >> std.  No stop_gradient on
+        # x0 — the shift cancels algebraically, so autodiff stays
+        # exact.
         xs = _stats_cast(data)
-        mean = jnp.mean(xs, axis=red).astype(moving_mean.dtype)
-        var = jnp.var(xs, axis=red).astype(moving_var.dtype)
+        n = 1
+        for i in red:
+            n *= data.shape[i]
+        idx = tuple(0 if i in red else slice(None)
+                    for i in range(data.ndim))
+        x0 = xs[idx]                               # (C,)
+        xc = xs - x0.reshape(bshape)
+        s1 = jnp.sum(xc, axis=red)
+        s2 = jnp.sum(xc * xc, axis=red)
+        mean = (x0 + s1 / n).astype(moving_mean.dtype)
+        var = jnp.maximum(s2 / n - (s1 / n) ** 2, 0.0) \
+            .astype(moving_var.dtype)
         new_mean = (momentum * moving_mean
                     + (1 - momentum) * jax.lax.stop_gradient(mean))
         new_var = (momentum * moving_var
@@ -228,9 +250,13 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     else:
         mean, var = moving_mean, moving_var
         new_mean, new_var = moving_mean, moving_var
+    # fused scale-shift form: fold gamma/rsqrt/mean/beta into per-
+    # channel scale+shift vectors first, so the full-size pass is a
+    # single fma instead of sub/mul/mul/add
     inv = jax.lax.rsqrt(var + eps)
-    out = ((data - mean.reshape(bshape)) * inv.reshape(bshape)
-           * g.reshape(bshape) + beta.reshape(bshape))
+    scale = (g * inv).astype(data.dtype)
+    shift = (beta - mean * g * inv).astype(data.dtype)
+    out = data * scale.reshape(bshape) + shift.reshape(bshape)
     out = out.astype(data.dtype)   # fp32 stats must not upcast the
     if _training:                  # activation stream
         return out, new_mean, new_var

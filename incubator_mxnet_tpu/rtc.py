@@ -147,23 +147,29 @@ def register(name, fn, *, vjp=None, arg_names=None,
             fwd_defaults = {}
         vjp_cache = {}   # params-tuple -> custom_vjp fn (trace cache)
 
+        def _build(full):
+            keys = sorted(full)
+
+            @jax.custom_vjp
+            def inner(*t):
+                return base(*t, **full)
+
+            inner.defvjp(
+                lambda *t: vjp_fwd(*t, **full),
+                lambda res, g: tuple(
+                    vjp_bwd(*(full[k] for k in keys), res, g)))
+            return inner
+
         @functools.wraps(fn)
         def fn(*arrays, **params):  # noqa: F811 — deliberate rewrap
             full = {**fwd_defaults, **params}
-            key = tuple(sorted(full.items()))
-            inner = vjp_cache.get(key)
+            try:    # unhashable static params (lists...) skip caching
+                key = tuple(sorted(full.items()))
+                inner = vjp_cache.get(key)
+            except TypeError:
+                return _build(full)(*arrays)
             if inner is None:
-                keys = sorted(full)
-
-                @jax.custom_vjp
-                def inner(*t):
-                    return base(*t, **full)
-
-                inner.defvjp(
-                    lambda *t: vjp_fwd(*t, **full),
-                    lambda res, g: tuple(
-                        vjp_bwd(*(full[k] for k in keys), res, g)))
-                vjp_cache[key] = inner
+                inner = vjp_cache[key] = _build(full)
             return inner(*arrays)
 
         if differentiable is None:

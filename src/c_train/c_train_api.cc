@@ -82,6 +82,12 @@ class _CTrain(object):
                         for k, v in shapes.items()}
         self._bufs = {}
         self._params_blob = b""
+        # loss semantics decided ONCE from the graph head, never from
+        # runtime output values: cross-entropy iff the head is a
+        # softmax classification output
+        head_op = getattr(sym._heads[0][0], "op", None)
+        self._ce_loss = bool(self._label_names) and \
+            head_op is not None and head_op.name == "SoftmaxOutput"
 
     def set_input(self, key, mv, size):
         shape = self._shapes[key]
@@ -107,9 +113,8 @@ class _CTrain(object):
     def _loss(self):
         out = self._mod.get_outputs()[0].asnumpy() \
             .astype(np.float64)
-        if self._label_names and out.ndim == 2 and \
-                np.allclose(out.sum(axis=1), 1.0, atol=1e-3):
-            # softmax-style head: mean cross-entropy vs first label
+        if self._ce_loss:
+            # softmax head: mean cross-entropy vs first label
             y = self._bufs[self._label_names[0]].astype(int).ravel()
             p = out[np.arange(out.shape[0]), y]
             return float(-np.log(np.clip(p, 1e-12, None)).mean())

@@ -268,3 +268,24 @@ def test_block_cast_bf16_with_deferred_init():
     assert str(out.dtype) == "bfloat16"
     for name, p in net.collect_params().items():
         assert str(p.data().dtype) == "bfloat16", name
+
+
+def test_batchnorm_variance_stable_at_large_mean():
+    """Single-pass BN variance must not cancel catastrophically
+    (review regression: raw E[x^2]-E[x]^2 gave 4x variance error at
+    mean/std ~ 3000; the shifted form is exact)."""
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu.ops.nn import batch_norm
+    rs = np.random.RandomState(3)
+    x = (rs.rand(8, 4, 5, 5).astype(np.float32) * 2.0 + 300.0)
+    g = np.ones(4, np.float32)
+    b = np.zeros(4, np.float32)
+    out, nm, nv = batch_norm(
+        jnp.asarray(x), jnp.asarray(g), jnp.asarray(b),
+        jnp.zeros(4), jnp.ones(4), fix_gamma=False,
+        _training=True, momentum=0.0)
+    true_var = x.astype(np.float64).var(axis=(0, 2, 3))
+    np.testing.assert_allclose(np.asarray(nv), true_var, rtol=5e-3)
+    # normalized output really is ~N(0,1), not rsqrt(eps)-blown
+    o = np.asarray(out)
+    assert abs(o.mean()) < 0.05 and 0.8 < o.std() < 1.2
