@@ -131,6 +131,27 @@ def _bench_transformer(dev, platform):
     L = int(os.environ.get("MXTPU_BENCH_SEQ", "1024"))
     V, D, LAYERS, HEADS = 32000, 1024, 12, 16
 
+    # the flash kernel has only ever been interpret-verified off-TPU;
+    # probe its REAL lowering on the chip first and fall back to XLA
+    # attention (recorded in the JSON) rather than dying mid-bench
+    flash_ok = None
+    if dev is not None and os.environ.get("MXTPU_FLASH") != "0":
+        try:
+            from incubator_mxnet_tpu.ops.flash import flash_attention
+            q = jax.device_put(
+                jnp.ones((2, 256, D // HEADS), jnp.bfloat16), dev)
+            out = flash_attention(q, q, q, causal=True,
+                                  interpret=False)
+            float(jax.device_get(out.reshape(-1)[:1])[0])
+            flash_ok = True
+        except Exception as exc:   # Mosaic lowering/compile failure
+            flash_ok = False
+            os.environ["MXTPU_FLASH"] = "0"
+            print(f"bench[transformer]: flash kernel failed on "
+                  f"{getattr(dev, 'device_kind', dev)}; falling back "
+                  f"to XLA attention — {type(exc).__name__}: "
+                  f"{str(exc)[:300]}", file=sys.stderr)
+
     with jax.default_device(cpu):
         mx.random.seed(0)
         net = TransformerLM(V, d_model=D, n_layers=LAYERS,
@@ -201,6 +222,7 @@ def _bench_transformer(dev, platform):
         "model_tflops_per_step": round(flops_tok * B * L / 1e12, 3),
         "achieved_tflops": round(flops_tok * tok_s / 1e12, 2),
         "peak_tflops": round(peak / 1e12, 1) if peak else None,
+        "flash_kernel": flash_ok,
     }))
 
 

@@ -25,6 +25,8 @@ assert name == "native", "native recordio failed to build"
 EOF
   # the C predict ABI (deployment to C clients)
   make -C src/c_predict
+  # the C training ABI (cpp-package analog)
+  make -C src/c_train
 }
 
 run_test() {

@@ -58,7 +58,7 @@ def main(argv=None):
         args.batch_size = 32
         args.num_classes = 10
         args.num_epochs = 2
-        args.iters_per_epoch = 10
+        args.iters_per_epoch = 16
         args.lr = 0.05
 
     import jax

@@ -28,7 +28,8 @@ from .symbol.symbol import _topo
 __all__ = ["Executor", "build_graph_fn"]
 
 
-def build_graph_fn(symbol, placements=None, default_device=None):
+def build_graph_fn(symbol, placements=None, default_device=None,
+                   tap=None):
     """Build the pure evaluation function of a Symbol graph.
 
     Returns fn(arg_vals: dict, aux_vals: dict, rng, is_train) ->
@@ -43,6 +44,12 @@ def build_graph_fn(symbol, placements=None, default_device=None):
     the node's eager op then executes there.  Placed graphs must run
     UN-jitted (explicit per-device transfer is not expressible inside
     a single-device jit trace).
+
+    ``tap(name, outputs)`` is the monitor hook (ref:
+    graph_executor.cc:121 monitor_callback): called after every
+    non-variable node with its output arrays.  Tapped graphs also run
+    un-jitted — per-op visibility is a debugging mode, fusion is
+    deliberately off.
     """
     order = _topo(symbol._heads)
     heads = list(symbol._heads)
@@ -81,6 +88,8 @@ def build_graph_fn(symbol, placements=None, default_device=None):
                 aux_nodes = node.inputs[-op.num_aux:]
                 for (anode, _), val in zip(aux_nodes, aux_new):
                     aux_updates[anode.name] = val
+            if tap is not None:
+                tap(node.name, outs_list)
             for i, o in enumerate(outs_list):
                 env[(id(node), i)] = o
         outputs = [env[(id(n), i)] for n, i in heads]
@@ -182,6 +191,9 @@ class Executor:
             symbol, placements=placements if self._placed else None,
             default_device=self._ctx.jax_device if self._placed
             else None)
+        self._placements = placements if self._placed else None
+        self._monitor_cb = None
+        self._run_tapped = None
         self._jit_fwd = {}
         self._jit_fwd_bwd = {}
         self._outputs = None
@@ -254,14 +266,44 @@ class Executor:
                 self.arg_dict[k]._data = jnp.asarray(
                     v, self.arg_dict[k]._data.dtype)
 
+    def set_monitor_callback(self, callback, monitor_all=False):
+        """Per-op output tap for debugging (ref:
+        MXExecutorSetMonitorCallback, graph_executor.cc:121).
+
+        While set, ``forward`` evaluates the graph eagerly un-jitted
+        and calls ``callback(op_name, [NDArray, ...])`` after every
+        node — full per-op visibility at debugging (not production)
+        speed.  Pass ``None`` to restore the fused executable.
+        """
+        if callback is None:
+            self._monitor_cb = None
+            self._run_tapped = None
+            return
+
+        def tapped(name, outs):
+            self._monitor_cb(name, [NDArray(o, self._ctx)
+                                    for o in outs])
+
+        self._monitor_cb = callback
+        self._run_tapped = build_graph_fn(
+            self._symbol, placements=self._placements,
+            default_device=self._ctx.jax_device if self._placements
+            else None, tap=tapped)
+
     def forward(self, is_train=False, **kwargs):
         """Run forward; returns output NDArrays
         (ref: graph_executor.cc Forward:81)."""
         self._set_inputs(kwargs)
         rng = random_state.next_key()
         self._last_rng = rng
-        outs, aux_upd = self._get_fwd(bool(is_train))(
-            self._jvals(self.arg_dict), self._jvals(self.aux_dict), rng)
+        if self._run_tapped is not None:    # monitor debugging mode
+            outs, aux_upd = self._run_tapped(
+                self._jvals(self.arg_dict), self._jvals(self.aux_dict),
+                rng, bool(is_train))
+        else:
+            outs, aux_upd = self._get_fwd(bool(is_train))(
+                self._jvals(self.arg_dict), self._jvals(self.aux_dict),
+                rng)
         for name, val in aux_upd.items():
             self.aux_dict[name]._data = val
         self._outputs = self._wrap_outputs(outs)
@@ -333,6 +375,11 @@ class Executor:
         self._last_rng = rng
         args_j = self._jvals(self.arg_dict)
         aux_j = self._jvals(self.aux_dict)
+        if self._run_tapped is not None:
+            # monitor debugging mode: one eager tapped forward for the
+            # per-op rows (tapping inside the vjp trace would hand the
+            # stat fn tracers); the real step below stays fused
+            self._run_tapped(args_j, aux_j, rng, True)
         if out_grads is not None:
             if isinstance(out_grads, NDArray):
                 out_grads = [out_grads]

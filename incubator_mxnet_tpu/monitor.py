@@ -3,11 +3,16 @@ python/mxnet/monitor.py Monitor:33; executor callback ref:
 src/executor/graph_executor.cc:121,1423).
 
 The reference streams every op's outputs through a stat function via
-the executor monitor callback.  Here the hook rides the imperative
-dispatch path (imperative_invoke), which covers eager NDArray code and
-non-hybridized Gluon — per-op visibility inside a compiled XLA
-executable doesn't exist by design (ops are fused away), matching the
-reference's own limitation that bulked segments skip the callback.
+the executor monitor callback.  Two hooks here:
+
+* imperative dispatch (imperative_invoke) — eager NDArray code and
+  non-hybridized Gluon;
+* ``Executor.set_monitor_callback`` (installed by
+  ``Monitor.install(executor)`` / ``Module.install_monitor``) — the
+  executor's forward switches to tapped un-jitted evaluation while
+  the callback is set, so every graph op's outputs reach the stat
+  function.  Debugging mode: fusion is deliberately off (the
+  production executable has the ops fused away).
 """
 import re
 
@@ -37,18 +42,28 @@ class Monitor:
 
     # ------------------------------------------------------------ install
     def install(self, target=None):
-        """Arm the global dispatch hook; optionally also watch a
-        Module/Executor's outputs (compiled path)."""
+        """Arm the global dispatch hook; an Executor target
+        additionally gets the per-op monitor callback (ref:
+        MXExecutorSetMonitorCallback) — its forward then runs in
+        tapped un-jitted mode, streaming EVERY op's outputs through
+        the stat function, not just the graph heads."""
         global _active_monitor
         _active_monitor = self
         if target is not None:
-            self._exes.append(target)
+            if hasattr(target, "set_monitor_callback"):
+                target.set_monitor_callback(self._observe)
+            if target not in self._exes:
+                self._exes.append(target)
         return self
 
     def uninstall(self):
         global _active_monitor
         if _active_monitor is self:
             _active_monitor = None
+        for exe in self._exes:
+            if hasattr(exe, "set_monitor_callback"):
+                exe.set_monitor_callback(None)
+        self._exes = []
 
     # ------------------------------------------------------------ batch
     def tic(self):
@@ -62,6 +77,8 @@ class Monitor:
             return []
         self.activated = False
         for exe in self._exes:
+            if getattr(exe, "_monitor_cb", None) is not None:
+                continue    # tapped: per-op rows already streamed
             outputs = getattr(exe, "outputs", None) or []
             names = []
             sym = getattr(exe, "_symbol", None)

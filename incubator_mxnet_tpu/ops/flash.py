@@ -56,8 +56,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, bq, bk, nk, causal,
 
     def body(j, carry):
         m, l, acc = carry
-        kb = k_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
-        vb = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        off = pl.multiple_of(j * bk, bk)   # aligned-slice hint (TPU)
+        kb = k_ref[0, pl.ds(off, bk), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(off, bk), :].astype(jnp.float32)
         s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32)
         if causal:
             q_pos = iq * bq + lax.broadcasted_iota(

@@ -135,3 +135,68 @@ def test_autograd_function():
     sig = 1 / (1 + np.exp(-x.asnumpy()))
     np.testing.assert_allclose(x.grad.asnumpy(), sig * (1 - sig),
                                rtol=1e-5)
+
+
+def test_monitor_compiled_path_per_op_rows():
+    """Module.install_monitor streams EVERY graph op's outputs (ref:
+    MXExecutorSetMonitorCallback), not just the heads, and
+    uninstall restores the fused executable."""
+    import incubator_mxnet_tpu as mx
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc1", num_hidden=8)
+    net = mx.sym.Activation(net, name="relu1", act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc2", num_hidden=4)
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[mx.io.DataDesc("data", (2, 6))],
+             label_shapes=[mx.io.DataDesc("softmax_label", (2,))],
+             for_training=True)
+    mod.init_params(mx.initializer.Xavier())
+    mon = mx.Monitor(interval=1, pattern=".*")
+    mod.install_monitor(mon)
+    mon.tic()
+    mod.forward(mx.io.DataBatch(
+        [mx.nd.array(np.random.RandomState(0)
+                     .rand(2, 6).astype("float32"))],
+        [mx.nd.array(np.zeros(2, "float32"))]), is_train=False)
+    rows = mon.toc()
+    names = {r[1] for r in rows}
+    assert any("fc1" in n for n in names), names
+    assert any("relu1" in n for n in names), names
+    assert any("softmax" in n for n in names), names
+    assert all(np.isfinite(r[2]) for r in rows)
+    mon.uninstall()
+    assert mod._exec._monitor_cb is None
+    mod.forward(mx.io.DataBatch(
+        [mx.nd.ones((2, 6))], [mx.nd.zeros((2,))]), is_train=False)
+
+
+def test_monitor_streams_during_training_step():
+    """The fit path calls forward_backward, which must also run
+    tapped while a monitor is installed (review regression: only
+    forward() was tapped, so fit(monitor=...) produced no rows)."""
+    import incubator_mxnet_tpu as mx
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc1", num_hidden=4)
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[mx.io.DataDesc("data", (2, 3))],
+             label_shapes=[mx.io.DataDesc("softmax_label", (2,))],
+             for_training=True)
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd")
+    mon = mx.Monitor(interval=1)
+    mod.install_monitor(mon)
+    mon.tic()
+    mod.forward_backward(mx.io.DataBatch(
+        [mx.nd.ones((2, 3))], [mx.nd.zeros((2,))]))
+    mod.update()
+    rows = mon.toc()
+    names = {r[1] for r in rows}
+    assert any("fc1" in n for n in names), names
+    mon.uninstall()
+    # untapped training still works after uninstall
+    mod.forward_backward(mx.io.DataBatch(
+        [mx.nd.ones((2, 3))], [mx.nd.zeros((2,))]))

@@ -62,3 +62,11 @@ def test_transformer_lm_seq_parallel_quick():
         summary = ex.main(["--quick", "--seq-parallel",
                            "--batch-size", "16"])
     assert summary["final_loss"] < summary["first_loss"] * 0.5
+
+
+def test_train_mnist_quick():
+    """Config 1: MLP on MNIST via the Module API (ref:
+    example/image-classification/train_mnist.py)."""
+    import train_mnist as ex
+    summary = ex.main(["--quick", "--num-epochs", "3"])
+    assert summary["val_acc"] > 0.95

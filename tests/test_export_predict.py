@@ -151,3 +151,58 @@ def test_predictor_positional_order_and_arity(tmp_path):
     import pytest as _pytest
     with _pytest.raises(ValueError, match="expected 2 inputs"):
         pred.predict(a)
+
+
+def test_bundle_roundtrip(tmp_path):
+    """tools/bundle.py (amalgamation-role deploy artifact): export a
+    model, build the bundle, and serve it from the bundle's own
+    loader in a fresh process with only the bundle dir."""
+    import json
+    import os
+    import subprocess
+    import sys as _sys
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon
+
+    mx.random.seed(5)
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(6, activation="relu"),
+                gluon.nn.Dense(2))
+    net.initialize(mx.initializer.Xavier())
+    net.hybridize()
+    x = mx.nd.array(np.random.RandomState(1).rand(3, 4)
+                    .astype("float32"))
+    ref = net(x).asnumpy()
+    prefix = str(tmp_path / "bnet")
+    net.export(prefix)
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    _sys.path.insert(0, os.path.join(repo, "tools"))
+    import bundle
+    out = bundle.build_bundle(prefix, {"data": (3, 4)},
+                              str(tmp_path / "bundle"))
+    man = json.load(open(os.path.join(out, "MANIFEST.json")))
+    assert man["inputs"] == ["data"]
+
+    # fresh process, bundle dir only (forced-CPU embedded runtime)
+    code = (
+        "import sys, numpy as np\n"
+        f"sys.path.insert(0, {out!r})\n"
+        "import predict\n"
+        "p = predict.load()\n"
+        "x = np.load(sys.argv[1])\n"
+        "np.save(sys.argv[2], p(data=x))\n")
+    xin = tmp_path / "x.npy"
+    xout = tmp_path / "y.npy"
+    np.save(xin, x.asnumpy())
+    env = dict(os.environ)
+    env["MXTPU_FORCE_CPU"] = "1"
+    env["PYTHONPATH"] = repo
+    r = subprocess.run([_sys.executable, "-c", code, str(xin),
+                       str(xout)], capture_output=True, text=True,
+                      timeout=300, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    got = np.load(xout)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)

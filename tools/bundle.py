@@ -1,0 +1,202 @@
+"""Deploy bundler — the amalgamation role, TPU-native (ref:
+amalgamation/amalgamation.py:1 which squashes the reference's C++
+graph executor into one compilation unit for minimal-dependency
+predict builds).
+
+Here the minimal-deploy artifact is not a single .cc — the compute
+executable is produced by XLA at load time — so the bundle is one
+self-contained directory (or .tar.gz) holding everything a C/C++ or
+Python client needs to serve an exported model:
+
+    model-symbol.json   graph
+    model-0000.params   weights (arg:/aux: tagged)
+    libmxtpu_predict.so embedded-interpreter C ABI
+    c_predict_api.h     the ABI header
+    predict.py          python loader (no framework import needed at
+                        the call site beyond the bundle dir on path)
+    MANIFEST.json       shapes, outputs, sha1s
+
+Usage:
+    python tools/bundle.py --model path/prefix --data-shape 1,3,224,224
+        [--out bundle_dir] [--tar]
+"""
+import argparse
+import hashlib
+import json
+import os
+import shutil
+import sys
+import tarfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+_PREDICT_PY = '''\
+"""Self-contained loader for this bundle (uses the framework if
+importable, else the C ABI via ctypes)."""
+import ctypes
+import json
+import os
+
+import numpy as np
+
+_D = os.path.dirname(os.path.abspath(__file__))
+
+
+def load():
+    man = json.load(open(os.path.join(_D, "MANIFEST.json")))
+    lib = ctypes.CDLL(os.path.join(_D, "libmxtpu_predict.so"))
+    u = ctypes.c_uint
+    lib.MXTPUGetLastError.restype = ctypes.c_char_p
+    lib.MXTPUPredCreate.argtypes = [
+        ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, u, ctypes.POINTER(ctypes.c_char_p),
+        ctypes.POINTER(u), ctypes.POINTER(u),
+        ctypes.POINTER(ctypes.c_void_p)]
+    lib.MXTPUPredSetInput.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_float), u]
+    lib.MXTPUPredForward.argtypes = [ctypes.c_void_p]
+    lib.MXTPUPredGetOutputShape.argtypes = [
+        ctypes.c_void_p, u, ctypes.POINTER(ctypes.POINTER(u)),
+        ctypes.POINTER(u)]
+    lib.MXTPUPredGetOutput.argtypes = [
+        ctypes.c_void_p, u, ctypes.POINTER(ctypes.c_float), u]
+    sym = open(os.path.join(_D, man["symbol"]), "rb").read()
+    params = open(os.path.join(_D, man["params"]), "rb").read()
+    inputs = man["inputs"]
+    keys = (ctypes.c_char_p * len(inputs))(
+        *[k.encode() for k in inputs])
+    flat, indptr = [], [0]
+    for k in inputs:
+        flat.extend(man["shapes"][k])
+        indptr.append(len(flat))
+    ind = (u * len(indptr))(*indptr)
+    shp = (u * len(flat))(*flat)
+    h = ctypes.c_void_p()
+    rc = lib.MXTPUPredCreate(sym, params, len(params), 1, 0,
+                             len(inputs), keys, ind, shp,
+                             ctypes.byref(h))
+    if rc != 0:
+        raise RuntimeError(lib.MXTPUGetLastError().decode())
+
+    def predict(**arrays):
+        for k, a in arrays.items():
+            a = np.ascontiguousarray(a, np.float32).ravel()
+            p = a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+            if lib.MXTPUPredSetInput(h, k.encode(), p, a.size) != 0:
+                raise RuntimeError(lib.MXTPUGetLastError().decode())
+        if lib.MXTPUPredForward(h) != 0:
+            raise RuntimeError(lib.MXTPUGetLastError().decode())
+        sd = ctypes.POINTER(u)()
+        nd_ = u()
+        if lib.MXTPUPredGetOutputShape(
+                h, 0, ctypes.byref(sd), ctypes.byref(nd_)) != 0:
+            raise RuntimeError(lib.MXTPUGetLastError().decode())
+        shape = tuple(sd[i] for i in range(nd_.value))
+        out = np.zeros(int(np.prod(shape)), np.float32)
+        op = out.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+        if lib.MXTPUPredGetOutput(h, 0, op, out.size) != 0:
+            raise RuntimeError(lib.MXTPUGetLastError().decode())
+        return out.reshape(shape)
+
+    return predict
+
+
+if __name__ == "__main__":
+    man = json.load(open(os.path.join(_D, "MANIFEST.json")))
+    p = load()
+    ins = {k: np.random.rand(*man["shapes"][k]).astype("float32")
+           for k in man["inputs"]}
+    out = p(**ins)
+    print("bundle OK; output shape", out.shape)
+'''
+
+
+def _sha1(path):
+    h = hashlib.sha1()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def build_bundle(model_prefix, data_shapes, out_dir, make_tar=False):
+    """Assemble the deploy directory; returns its path (or the .tar.gz
+    path when make_tar)."""
+    import glob
+    sym_src = model_prefix + "-symbol.json"
+    # newest checkpoint wins: a training series m-0001..m-0010 must
+    # ship the final epoch's weights, not the first
+    cands = sorted(glob.glob(model_prefix + "-[0-9]*.params"))
+    params_src = cands[-1] if cands else None
+    if params_src is None or not os.path.exists(sym_src):
+        raise FileNotFoundError(
+            f"need {sym_src} + {model_prefix}-NNNN.params "
+            "(HybridBlock.export / Module.save_checkpoint artifacts)")
+    so_src = os.path.join(REPO, "src", "c_predict",
+                          "libmxtpu_predict.so")
+    if not os.path.exists(so_src):
+        import subprocess
+        subprocess.run(["make", "-C", os.path.dirname(so_src)],
+                       check=True, capture_output=True)
+    os.makedirs(out_dir, exist_ok=True)
+    names = {}
+    for src, dst in [(sym_src, "model-symbol.json"),
+                     (params_src, "model-0000.params"),
+                     (so_src, "libmxtpu_predict.so"),
+                     (os.path.join(REPO, "src", "c_predict",
+                                   "c_predict_api.h"),
+                      "c_predict_api.h")]:
+        shutil.copy2(src, os.path.join(out_dir, dst))
+        names[dst] = _sha1(os.path.join(out_dir, dst))
+    with open(os.path.join(out_dir, "predict.py"), "w") as f:
+        f.write(_PREDICT_PY)
+    manifest = {
+        "symbol": "model-symbol.json",
+        "params": "model-0000.params",
+        "inputs": list(data_shapes),
+        "shapes": {k: list(v) for k, v in data_shapes.items()},
+        "sha1": names,
+    }
+    with open(os.path.join(out_dir, "MANIFEST.json"), "w") as f:
+        json.dump(manifest, f, indent=2)
+    if make_tar:
+        tar_path = out_dir.rstrip("/") + ".tar.gz"
+        with tarfile.open(tar_path, "w:gz") as t:
+            t.add(out_dir, arcname=os.path.basename(out_dir))
+        return tar_path
+    return out_dir
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--model", required=True,
+                   help="export prefix (prefix-symbol.json + params)")
+    p.add_argument("--data-shape", required=True, action="append",
+                   help="input shape, e.g. 1,3,224,224 or "
+                   "name:1,3,224,224 (repeatable)")
+    p.add_argument("--out", default=None)
+    p.add_argument("--tar", action="store_true")
+    args = p.parse_args(argv)
+    shapes = {}
+    for i, spec in enumerate(args.data_shape):
+        if ":" in spec:
+            name, dims = spec.split(":", 1)
+        else:
+            name, dims = ("data" if i == 0 else f"data{i}"), spec
+        shapes[name] = tuple(int(d) for d in dims.split(","))
+    out = args.out or os.path.basename(args.model) + "_bundle"
+    path = build_bundle(args.model, shapes, out, args.tar)
+    if args.tar:
+        with tarfile.open(path) as t:
+            files = sorted(os.path.basename(m) for m in t.getnames()
+                           if "/" in m)
+    else:
+        files = sorted(os.listdir(out))
+    print(json.dumps({"bundle": path, "files": files}))
+    return path
+
+
+if __name__ == "__main__":
+    main()
