@@ -105,8 +105,6 @@ def main(argv=None):
         n_train = 2048 if args.quick else 8192
         xtr, ytr = synthetic_mnist(n_train, rs)
         xva, yva = synthetic_mnist(512, rs)
-        if args.network == "lenet":
-            pass   # symbol reshapes internally from flat input
         train = mx.io.NDArrayIter(xtr, ytr, args.batch_size,
                                   shuffle=True)
         val = mx.io.NDArrayIter(xva, yva, args.batch_size)

@@ -375,10 +375,12 @@ class Executor:
         self._last_rng = rng
         args_j = self._jvals(self.arg_dict)
         aux_j = self._jvals(self.aux_dict)
-        if self._run_tapped is not None:
+        if self._run_tapped is not None and _refresh_outputs:
             # monitor debugging mode: one eager tapped forward for the
             # per-op rows (tapping inside the vjp trace would hand the
-            # stat fn tracers); the real step below stays fused
+            # stat fn tracers); the real step below stays fused.  The
+            # backward() path (_refresh_outputs=False) reuses the rng
+            # of a tapped forward that already streamed these rows.
             self._run_tapped(args_j, aux_j, rng, True)
         if out_grads is not None:
             if isinstance(out_grads, NDArray):

@@ -7,9 +7,10 @@ matrix out of HBM — each (query-tile, key-tile) block is materialized
 only in VMEM, with running max/denominator carried across key tiles.
 
 Registered as the differentiable op ``_flash_attention`` so both the
-eager tape and compiled paths use it; the backward recomputes through
-the reference XLA attention (memory was the point of the forward; the
-backward's FLOPs are the same either way).
+eager tape and compiled paths use it; the backward is the tiled
+FlashAttention recipe too — dq/dk/dv kernels rebuild each P tile from
+the forward's log-sum-exp residual (delta = rowsum(g*o)), so no L x L
+tensor exists in HBM on either direction.
 
 On non-TPU backends the kernel runs in Pallas interpret mode (tests
 exercise it on CPU); numerics match the reference implementation to
@@ -43,8 +44,8 @@ def _reference_attention(q, k, v, causal, scale):
                       v.astype(jnp.float32)).astype(q.dtype)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, bq, bk, nk, causal,
-                scale):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, nk,
+                causal, scale):
     from jax.experimental import pallas as pl
 
     iq = pl.program_id(1)
@@ -83,6 +84,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, bq, bk, nk, causal,
         upper = nk
     m, l, acc = lax.fori_loop(0, upper, body, (m, l, acc))
     o_ref[0] = (acc / l).astype(o_ref.dtype)
+    # log-sum-exp residual: what the backward needs to rebuild P
+    # tile-by-tile without the L x L score matrix
+    lse_ref[0] = (m[:, 0] + jnp.log(l[:, 0]))
 
 
 def _flash_fwd(q, k, v, causal, scale, interpret):
@@ -95,7 +99,7 @@ def _flash_fwd(q, k, v, causal, scale, interpret):
     kernel = functools.partial(_fwd_kernel, bq=bq, bk=bk,
                                nk=lk // bk, causal=causal,
                                scale=scale)
-    return pl.pallas_call(
+    o, lse = pl.pallas_call(
         kernel,
         grid=(bh, lq // bq),
         in_specs=[
@@ -103,10 +107,147 @@ def _flash_fwd(q, k, v, causal, scale, interpret):
             pl.BlockSpec((1, lk, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, lk, d), lambda b, i: (b, 0, 0)),
         ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((bh, lq), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+               dq_ref, *, bq, bk, nk, causal, scale):
+    from jax.experimental import pallas as pl
+
+    iq = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)                  # (BQ, D)
+    g = g_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, None]                         # (BQ, 1)
+    delta = delta_ref[0][:, None]
+    dq = jnp.zeros_like(q)
+
+    def body(j, dq):
+        off = pl.multiple_of(j * bk, bk)
+        kb = k_ref[0, pl.ds(off, bk), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(off, bk), :].astype(jnp.float32)
+        s = jnp.dot(q, kb.T,
+                    preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = iq * bq + lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0)
+            k_pos = j * bk + lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG)
+        p = jnp.exp(s - lse)
+        dp = jnp.dot(g, vb.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        return dq + jnp.dot(ds, kb,
+                            preferred_element_type=jnp.float32)
+
+    upper = jnp.minimum(nk, ((iq + 1) * bq + bk - 1) // bk) \
+        if causal else nk
+    dq = lax.fori_loop(0, upper, body, dq)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, bq, bk, nq, causal, scale):
+    from jax.experimental import pallas as pl
+
+    jk = pl.program_id(1)
+    kb = k_ref[0].astype(jnp.float32)                 # (BK, D)
+    vb = v_ref[0].astype(jnp.float32)
+    dk = jnp.zeros_like(kb)
+    dv = jnp.zeros_like(vb)
+
+    def body(i, carry):
+        dk, dv = carry
+        off = pl.multiple_of(i * bq, bq)
+        qb = q_ref[0, pl.ds(off, bq), :].astype(jnp.float32)
+        gb = g_ref[0, pl.ds(off, bq), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(off, bq)][:, None]
+        delta = delta_ref[0, pl.ds(off, bq)][:, None]
+        s = jnp.dot(qb, kb.T,
+                    preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = i * bq + lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0)
+            k_pos = jk * bk + lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG)
+        p = jnp.exp(s - lse)
+        dv = dv + jnp.dot(p.T, gb,
+                          preferred_element_type=jnp.float32)
+        dp = jnp.dot(gb, vb.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk = dk + jnp.dot(ds.T, qb,
+                          preferred_element_type=jnp.float32)
+        return dk, dv
+
+    # causal: q tiles strictly above this k tile's diagonal see none
+    # of it — start at the first tile that can attend here
+    lower = (jk * bk) // bq if causal else 0
+    dk, dv = lax.fori_loop(lower, nq, body, (dk, dv))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, g, causal, scale, interpret):
+    """Tiled backward: rebuilds each P tile from (q, k, lse) — no
+    L x L tensor in HBM on the gradient path either (the FlashAttention
+    backward recipe: delta = rowsum(g * o), dS = P*(dP - delta))."""
+    from jax.experimental import pallas as pl
+
+    bh, lq, d = q.shape
+    lk = k.shape[1]
+    bq = min(128, lq)
+    bk = min(128, lk)
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)                           # (BH, LQ)
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, bq=bq, bk=bk, nk=lk // bk,
+                          causal=causal, scale=scale),
+        grid=(bh, lq // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, lk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, lk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+        ],
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         interpret=interpret,
-    )(q, k, v)
+    )(q, k, v, g, lse, delta)
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, bq=bq, bk=bk, nq=lq // bq,
+                          causal=causal, scale=scale),
+        grid=(bh, lk // bk),
+        in_specs=[
+            pl.BlockSpec((1, lq, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, lq, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, lq), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, lq), lambda b, j: (b, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
 
 
 def _supported(q, k):
@@ -117,19 +258,17 @@ def _supported(q, k):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def _flash(q, k, v, causal, scale, interpret):
-    return _flash_fwd(q, k, v, causal, scale, interpret)
+    return _flash_fwd(q, k, v, causal, scale, interpret)[0]
 
 
 def _flash_vjp_fwd(q, k, v, causal, scale, interpret):
-    return _flash_fwd(q, k, v, causal, scale, interpret), (q, k, v)
+    o, lse = _flash_fwd(q, k, v, causal, scale, interpret)
+    return o, (q, k, v, o, lse)
 
 
 def _flash_vjp_bwd(causal, scale, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q, k, v: _reference_attention(q, k, v, causal, scale),
-        q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = res
+    return _flash_bwd(q, k, v, o, lse, g, causal, scale, interpret)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
