@@ -27,6 +27,13 @@ EOF
   make -C src/c_predict
   # the C training ABI (cpp-package analog)
   make -C src/c_train
+  # the native JPEG batch decoder (input-pipeline fast path)
+  make -C src/imgdec
+  python - <<'EOF'
+from incubator_mxnet_tpu.image import native_dec
+assert native_dec.available(), "native image decoder failed to build"
+print("imgdec backend: native")
+EOF
 }
 
 run_test() {

@@ -1,0 +1,268 @@
+/*
+ * Native multithreaded JPEG -> NCHW float32 batch decoder (ref role:
+ * src/io/image_aug_default.cc + iter_image_recordio_2.cc's N decode
+ * threads — the reference's answer to Python-side decode being too
+ * slow to feed the device; measured here: PIL decode is GIL-bound
+ * flat at ~1k img/s regardless of thread count).
+ *
+ * Pipeline per image (the fast-path subset of CreateAugmenter):
+ *   libjpeg decode (RGB) -> optional shorter-edge bilinear resize ->
+ *   center crop to (H, W) (bilinear up-resize when smaller) ->
+ *   optional horizontal mirror -> (px - mean[c]) / std[c] -> CHW.
+ *
+ * Plain C ABI, no Python anywhere: the GIL never serializes it.
+ */
+#include <cstdio>   // jpeglib.h needs FILE declared first
+
+#include <jpeglib.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <condition_variable>
+#include <csetjmp>
+#include <cstdint>
+#include <cstring>
+#include <functional>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+/* first error wins; read from any thread (the per-worker
+ * thread_local variant made imgdec_last_error() always empty) */
+std::mutex g_err_mu;
+std::string g_err;
+
+void set_err(const char *msg) {
+  std::lock_guard<std::mutex> lock(g_err_mu);
+  if (g_err.empty()) g_err = msg;
+}
+
+struct ErrMgr {
+  jpeg_error_mgr pub;
+  jmp_buf jb;
+};
+
+void err_exit(j_common_ptr cinfo) {
+  char msg[JMSG_LENGTH_MAX];
+  (*cinfo->err->format_message)(cinfo, msg);
+  set_err(msg);
+  longjmp(reinterpret_cast<ErrMgr *>(cinfo->err)->jb, 1);
+}
+
+/* decode one JPEG into an RGB byte buffer; returns false on error */
+bool decode_rgb(const uint8_t *buf, size_t size,
+                std::vector<uint8_t> *out, int *h, int *w) {
+  jpeg_decompress_struct cinfo;
+  ErrMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = err_exit;
+  if (setjmp(jerr.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t *>(buf),
+               static_cast<unsigned long>(size));
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  *w = cinfo.output_width;
+  *h = cinfo.output_height;
+  out->resize(static_cast<size_t>(*w) * *h * 3);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    JSAMPROW row =
+        out->data() + static_cast<size_t>(cinfo.output_scanline) * *w * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+/* bilinear resize RGB bytes (ih,iw) -> floats (oh,ow), HWC */
+void resize_bilinear(const uint8_t *src, int ih, int iw, float *dst,
+                     int oh, int ow) {
+  const float sy = oh > 1 ? float(ih - 1) / (oh - 1) : 0.f;
+  const float sx = ow > 1 ? float(iw - 1) / (ow - 1) : 0.f;
+  for (int y = 0; y < oh; ++y) {
+    float fy = y * sy;
+    int y0 = static_cast<int>(fy);
+    int y1 = std::min(y0 + 1, ih - 1);
+    float wy = fy - y0;
+    for (int x = 0; x < ow; ++x) {
+      float fx = x * sx;
+      int x0 = static_cast<int>(fx);
+      int x1 = std::min(x0 + 1, iw - 1);
+      float wx = fx - x0;
+      for (int c = 0; c < 3; ++c) {
+        float a = src[(y0 * iw + x0) * 3 + c];
+        float b = src[(y0 * iw + x1) * 3 + c];
+        float d = src[(y1 * iw + x0) * 3 + c];
+        float e = src[(y1 * iw + x1) * 3 + c];
+        dst[(y * ow + x) * 3 + c] =
+            a * (1 - wy) * (1 - wx) + b * (1 - wy) * wx +
+            d * wy * (1 - wx) + e * wy * wx;
+      }
+    }
+  }
+}
+
+bool process_one(const uint8_t *buf, size_t size, int oh, int ow,
+                 int resize_short, int mirror, const float *mean,
+                 const float *stdv, float *out /* 3*oh*ow CHW */) {
+  std::vector<uint8_t> rgb;
+  int ih = 0, iw = 0;
+  if (!decode_rgb(buf, size, &rgb, &ih, &iw)) return false;
+
+  std::vector<float> hwc(static_cast<size_t>(oh) * ow * 3);
+  std::vector<uint8_t> tmp;
+  if (resize_short > 0 && std::min(ih, iw) != resize_short) {
+    int nh, nw;
+    if (ih < iw) {
+      nh = resize_short;
+      nw = static_cast<int>(
+          std::lround(double(iw) * resize_short / ih));
+    } else {
+      nw = resize_short;
+      nh = static_cast<int>(
+          std::lround(double(ih) * resize_short / iw));
+    }
+    std::vector<float> f(static_cast<size_t>(nh) * nw * 3);
+    resize_bilinear(rgb.data(), ih, iw, f.data(), nh, nw);
+    tmp.resize(f.size());
+    for (size_t i = 0; i < f.size(); ++i) {
+      tmp[i] = static_cast<uint8_t>(
+          std::min(255.f, std::max(0.f, f[i] + 0.5f)));
+    }
+    rgb.swap(tmp);
+    ih = nh;
+    iw = nw;
+  }
+
+  /* PIL center_crop semantics: crop the centered
+   * (min(ih,oh), min(iw,ow)) region, then resize the crop to the
+   * target — identical pixels when the source already matches */
+  int ch = std::min(ih, oh), cw = std::min(iw, ow);
+  int y0 = (ih - ch) / 2, x0 = (iw - cw) / 2;
+  if (ch == oh && cw == ow) {
+    for (int y = 0; y < oh; ++y)
+      for (int x = 0; x < ow; ++x)
+        for (int c = 0; c < 3; ++c)
+          hwc[(y * ow + x) * 3 + c] =
+              rgb[((y0 + y) * iw + (x0 + x)) * 3 + c];
+  } else {
+    std::vector<uint8_t> crop(static_cast<size_t>(ch) * cw * 3);
+    for (int y = 0; y < ch; ++y)
+      for (int x = 0; x < cw; ++x)
+        for (int c = 0; c < 3; ++c)
+          crop[(y * cw + x) * 3 + c] =
+              rgb[((y0 + y) * iw + (x0 + x)) * 3 + c];
+    resize_bilinear(crop.data(), ch, cw, hwc.data(), oh, ow);
+  }
+
+  for (int c = 0; c < 3; ++c) {
+    const float m = mean ? mean[c] : 0.f;
+    const float s = stdv ? stdv[c] : 1.f;
+    for (int y = 0; y < oh; ++y) {
+      for (int x = 0; x < ow; ++x) {
+        int sx = mirror ? (ow - 1 - x) : x;
+        out[(c * oh + y) * ow + x] =
+            (hwc[(y * ow + sx) * 3 + c] - m) / s;
+      }
+    }
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char *imgdec_last_error() { return g_err.c_str(); }
+
+/* Decode n JPEGs into out (n, 3, oh, ow) float32 with an internal
+ * thread pool.  bufs/sizes: per-image byte buffers; mirror: per-image
+ * 0/1 flags or NULL; mean/stdv: 3 floats or NULL; resize_short: 0 to
+ * disable.  Returns 0, or the number of failed images. */
+/* persistent worker pool: threads are created once (growing up to
+ * the largest nthreads ever requested) and reused across batches.
+ * Every index claim happens under the mutex — at ~1 ms/image decode
+ * granularity the lock is uncontended, and it makes cross-batch
+ * stale-worker races structurally impossible. */
+class Pool {
+ public:
+  void run(int nthreads, int n, std::function<void(int)> task) {
+    std::unique_lock<std::mutex> lock(mu_);
+    while (nworkers_ < nthreads - 1) {
+      std::thread([this] { loop(); }).detach();   // workers live for
+      ++nworkers_;                                // the process
+    }
+    task_ = std::move(task);
+    next_ = 0;
+    total_ = n;
+    pending_ = n;
+    cv_.notify_all();
+    work(lock);       // the caller works too (nthreads == 1 case)
+    done_cv_.wait(lock, [this] { return pending_ == 0; });
+    task_ = nullptr;
+    total_ = 0;
+  }
+
+ private:
+  void loop() {
+    std::unique_lock<std::mutex> lock(mu_);
+    for (;;) {
+      cv_.wait(lock, [this] { return next_ < total_; });
+      work(lock);
+    }
+  }
+
+  /* claims and runs items; enters and leaves with the lock HELD */
+  void work(std::unique_lock<std::mutex> &lock) {
+    while (next_ < total_) {
+      int i = next_++;
+      lock.unlock();
+      task_(i);
+      lock.lock();
+      if (--pending_ == 0) done_cv_.notify_all();
+    }
+  }
+
+  std::mutex mu_;
+  std::condition_variable cv_, done_cv_;
+  int nworkers_ = 0;
+  std::function<void(int)> task_;
+  int next_ = 0;
+  int total_ = 0;
+  int pending_ = 0;
+};
+
+Pool &pool() {
+  /* heap singleton, never destroyed: detached workers may still be
+   * parked in cv_.wait at process exit */
+  static Pool *p = new Pool;
+  return *p;
+}
+
+int imgdec_batch(const uint8_t *const *bufs, const int64_t *sizes,
+                 int n, int oh, int ow, int resize_short,
+                 const uint8_t *mirror, const float *mean,
+                 const float *stdv, float *out, int nthreads) {
+  std::atomic<int> failed(0);
+  if (nthreads < 1) nthreads = 1;
+  nthreads = std::min(nthreads, n);
+  pool().run(nthreads, n, [&](int i) {
+    bool ok = process_one(
+        bufs[i], static_cast<size_t>(sizes[i]), oh, ow,
+        resize_short, mirror ? mirror[i] : 0, mean, stdv,
+        out + static_cast<size_t>(i) * 3 * oh * ow);
+    if (!ok) failed.fetch_add(1);
+  });
+  return failed.load();
+}
+
+}  // extern "C"
