@@ -76,8 +76,10 @@ class ImageRecordIter(DataIter):
                     mirror_p=0.5 if rand_mirror else 0.0,
                     mean=np.asarray(mean, np.float32)
                     if mean is not None else None,
+                    # CreateAugmenter only normalizes when mean is
+                    # set; std alone must match that (no-op)
                     std=np.asarray(std, np.float32)
-                    if std is not None else None,
+                    if std is not None and mean is not None else None,
                     nthreads=int(preprocess_threads))
         self._pool = ThreadPoolExecutor(max_workers=preprocess_threads)
         # load the record offsets once; shuffle epoch-wise
@@ -144,7 +146,10 @@ class ImageRecordIter(DataIter):
             return self._rec.read()
 
     def _decode_one(self, raw):
-        header, img_bytes = rio.unpack(raw)
+        return self._decode_unpacked(rio.unpack(raw))
+
+    def _decode_unpacked(self, pair):
+        header, img_bytes = pair
         arr = augment_to_chw(imdecode(img_bytes), self.auglist)
         label = np.atleast_1d(np.asarray(header.label, np.float32))
         return arr, label
@@ -186,30 +191,44 @@ class ImageRecordIter(DataIter):
                                 np.float32)
                 label = np.zeros((self.batch_size, self.label_width),
                                  np.float32)
-                use_native = False
+                done = False
                 if self._native is not None:
                     unpacked = [rio.unpack(raw) for raw in raws]
-                    # libjpeg-only: a batch with any non-JPEG record
-                    # (PNG/BMP) takes the PIL path instead
-                    use_native = all(
-                        ib[:2] == b"\xff\xd8" for _, ib in unpacked)
-                if use_native:
-                    from . import native_dec
-                    cfg = self._native
-                    imgs = [ib for _, ib in unpacked]
-                    mirror = None
-                    if cfg["mirror_p"] > 0:
-                        mirror = (np.random.rand(len(imgs))
-                                  < cfg["mirror_p"])
-                    native_dec.decode_batch(
-                        imgs, (h, w), mirror=mirror, mean=cfg["mean"],
-                        std=cfg["std"], nthreads=cfg["nthreads"],
-                        out=data[:len(imgs)])
-                    for j, (header, _) in enumerate(unpacked):
-                        lab = np.atleast_1d(np.asarray(
-                            header.label, np.float32))
-                        label[j] = lab[:self.label_width]
-                else:
+                    # libjpeg-only: non-JPEG batches (PNG/BMP) or
+                    # jpegs libjpeg rejects but PIL handles (CMYK)
+                    # fall back to the PIL path on the SAME unpacked
+                    # records — never abort what PIL could decode
+                    if all(ib[:2] == b"\xff\xd8"
+                           for _, ib in unpacked):
+                        from . import native_dec
+                        cfg = self._native
+                        imgs = [ib for _, ib in unpacked]
+                        mirror = None
+                        if cfg["mirror_p"] > 0:
+                            mirror = (np.random.rand(len(imgs))
+                                      < cfg["mirror_p"])
+                        try:
+                            native_dec.decode_batch(
+                                imgs, (h, w), mirror=mirror,
+                                mean=cfg["mean"], std=cfg["std"],
+                                nthreads=cfg["nthreads"],
+                                out=data[:len(imgs)])
+                            done = True
+                        except ValueError:
+                            pass    # PIL fallback below decides
+                    if done:
+                        for j, (header, _) in enumerate(unpacked):
+                            lab = np.atleast_1d(np.asarray(
+                                header.label, np.float32))
+                            label[j] = lab[:self.label_width]
+                    else:
+                        decoded = list(self._pool.map(
+                            self._decode_unpacked, unpacked))
+                        for j, (arr, lab) in enumerate(decoded):
+                            data[j] = arr
+                            label[j] = lab[:self.label_width]
+                        done = True
+                if not done:
                     decoded = list(self._pool.map(self._decode_one,
                                                   raws))
                     for j, (arr, lab) in enumerate(decoded):

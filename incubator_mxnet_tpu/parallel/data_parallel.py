@@ -85,7 +85,7 @@ class ShardedTrainStep:
                  mesh=None, loss_fn=None, rules=None, batch_axis=0,
                  seq_axis=None, donate=True, example_args=None,
                  compute_dtype=None, grad_accum=1, remat=False,
-                 lr_schedule=None):
+                 lr_schedule=None, zero=False):
         if mesh is None:
             mesh = current_mesh()  # ambient mesh from use_mesh(...)
         self.mesh = mesh if mesh is not None else make_mesh()
@@ -114,6 +114,31 @@ class ShardedTrainStep:
         pvals = self.pure.params()
         svals = self.pure.states()
         self.param_shardings = self.rules.shardings(self.mesh, pvals)
+        # what the forward/backward math wants (pre-ZeRO layout)
+        self._compute_shardings = dict(self.param_shardings)
+        self.zero = bool(zero) and self.mesh.shape.get("dp", 1) > 1
+        if self.zero:
+            # ZeRO-1: fp32 master params — and, via zeros_like
+            # inheritance, every optimizer-state moment — live
+            # dp-sharded; each dp rank updates only its slice and
+            # GSPMD inserts the reduce-scatter/all-gather pair.
+            # Memory per chip: params + opt state shrink by dp.
+            # Rule-sharded (tp) leaves keep their layout.
+            dp = self.mesh.shape["dp"]
+
+            def zshard(name, a):
+                base = self.param_shardings[name]
+                if base.spec != P():
+                    return base
+                for ax, d in enumerate(a.shape):
+                    if d > 0 and d % dp == 0:
+                        spec = [None] * a.ndim
+                        spec[ax] = "dp"
+                        return NamedSharding(self.mesh, P(*spec))
+                return base
+
+            self.param_shardings = {n: zshard(n, a)
+                                    for n, a in pvals.items()}
         self.state_shardings = {
             n: NamedSharding(self.mesh, P()) for n in svals}
         self.params = _owned_put_tree(pvals, self.param_shardings)
@@ -143,12 +168,21 @@ class ShardedTrainStep:
                 lambda p, s, xs, rng: pure.apply(
                     p, s, xs, rng, training=True))
 
+        zero = self.zero
+        compute_sh = self._compute_shardings
+
         def grad_of(params, states, xb, yb, rng):
             def lossf(p):
                 xin = xb
                 if cdt is not None:
                     p = _cast_floats(p, cdt)
                     xin = _cast_floats(xb, cdt)
+                if zero:
+                    # gather the dp-sharded masters back to the
+                    # compute layout AFTER the low-precision cast, so
+                    # the all-gather moves bf16 bytes, not fp32
+                    p = jax.lax.with_sharding_constraint(
+                        p, {n: compute_sh[n] for n in p})
                 outs, new_states = apply(p, states, [xin], rng)
                 return loss_fn(outs, yb), new_states
             return jax.value_and_grad(lossf, has_aux=True)(params)

@@ -252,6 +252,12 @@ int imgdec_batch(const uint8_t *const *bufs, const int64_t *sizes,
                  int n, int oh, int ow, int resize_short,
                  const uint8_t *mirror, const float *mean,
                  const float *stdv, float *out, int nthreads) {
+  {
+    /* per-call error scope: the reported message must belong to THIS
+     * batch's failure, not a handled one from minutes ago */
+    std::lock_guard<std::mutex> lock(g_err_mu);
+    g_err.clear();
+  }
   std::atomic<int> failed(0);
   if (nthreads < 1) nthreads = 1;
   nthreads = std::min(nthreads, n);

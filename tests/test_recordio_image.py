@@ -361,3 +361,64 @@ def test_non_jpeg_batch_falls_back_to_pil(tmp_path):
     batches = list(it)
     assert len(batches) == 2
     assert np.isfinite(batches[0].data[0].asnumpy()).all()
+
+
+def test_native_decode_failure_falls_back_per_batch(tmp_path):
+    """A JPEG libjpeg rejects (truncated) inside a native batch must
+    fall back to the PIL path, not abort the epoch (review
+    regression: CMYK/odd JPEGs that PIL handles)."""
+    from incubator_mxnet_tpu.image import native_dec
+    if not native_dec.available():
+        pytest.skip("native decoder unavailable")
+    import io as pyio
+
+    from PIL import Image
+
+    rs = np.random.RandomState(4)
+    prefix = str(tmp_path / "t")
+    rec = rio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    for i in range(4):
+        img = (rs.rand(16, 16, 3) * 255).astype(np.uint8)
+        b = pyio.BytesIO()
+        Image.fromarray(img).save(b, format="JPEG", quality=95)
+        raw = b.getvalue()
+        if i == 2:
+            raw = raw[:len(raw) // 2]   # truncated: both paths fail
+        rec.write_idx(i, rio.pack(
+            rio.IRHeader(0, float(i), i, 0), raw))
+    rec.close()
+    it = mx.io.ImageRecordIter(path_imgrec=prefix + ".rec",
+                               data_shape=(3, 16, 16), batch_size=4,
+                               shuffle=False)
+    # truly corrupt record: PIL fallback also fails -> surfaces
+    with pytest.raises(Exception):
+        next(iter(it))
+
+
+def test_native_std_only_matches_pil_noop(tmp_path):
+    """std_* without mean_*: CreateAugmenter skips normalization, so
+    the native path must too (review regression)."""
+    import io as pyio
+
+    from PIL import Image
+
+    rs = np.random.RandomState(5)
+    prefix = str(tmp_path / "s")
+    rec = rio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    img = (rs.rand(16, 16, 3) * 255).astype(np.uint8)
+    b = pyio.BytesIO()
+    Image.fromarray(img).save(b, format="JPEG", quality=95)
+    rec.write_idx(0, rio.pack(rio.IRHeader(0, 0.0, 0, 0),
+                              b.getvalue()))
+    rec.close()
+
+    def run(native):
+        os.environ["MXTPU_NATIVE_DECODE"] = "1" if native else "0"
+        try:
+            it = mx.io.ImageRecordIter(
+                path_imgrec=prefix + ".rec", data_shape=(3, 16, 16),
+                batch_size=1, std_r=58.0, std_g=57.0, std_b=57.0)
+            return next(iter(it)).data[0].asnumpy()
+        finally:
+            os.environ.pop("MXTPU_NATIVE_DECODE", None)
+    np.testing.assert_allclose(run(True), run(False), atol=1e-4)
