@@ -84,12 +84,21 @@ def _subprocess_probe(timeout_s):
     return "error", " | ".join(tail)
 
 
-def _probe_accelerator(retries=3, delay=10.0, timeout_s=180.0):
+def _probe_accelerator(retries=None, delay=None, timeout_s=None):
     """Return the accelerator device, or None (CPU-only host).
 
     Health is established in a subprocess (hang-proof); only a healthy
-    backend is then initialized in this process.
+    backend is then initialized in this process.  The probe window is
+    env-tunable (VERDICT r4 next-step 1a) — a driver run can wait out
+    a flaky tunnel with MXTPU_PROBE_RETRIES/_TIMEOUT/_DELAY; defaults
+    give ~32 min of patience with backoff.  On final failure, a full
+    tunnel diagnostic (tools/tpu_doctor.py) is printed AND persisted
+    to BENCH_DIAG_<ts>.json so a red run is self-explaining.
     """
+    retries = retries or int(os.environ.get("MXTPU_PROBE_RETRIES", 6))
+    delay = delay or float(os.environ.get("MXTPU_PROBE_DELAY", 20.0))
+    timeout_s = timeout_s or float(
+        os.environ.get("MXTPU_PROBE_TIMEOUT", 240.0))
     if os.environ.get("MXTPU_BENCH_PLATFORM") == "cpu":
         # explicit CPU run (local testing): never touch the plugin
         import jax
@@ -107,9 +116,25 @@ def _probe_accelerator(retries=3, delay=10.0, timeout_s=180.0):
         print(f"bench: accelerator probe attempt {attempt + 1}/"
               f"{retries} failed — {last}", file=sys.stderr)
         if attempt < retries - 1:
-            time.sleep(delay)
+            time.sleep(delay * (1.5 ** attempt))
     print("bench: FATAL: accelerator backend unavailable after "
           f"{retries} attempts; last: {last}", file=sys.stderr)
+    try:
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "tools"))
+        from tpu_doctor import diagnose
+        diag = diagnose(probe_timeout=min(timeout_s, 60), clean=True)
+        diag["probe_history"] = last
+        blob = json.dumps(diag, indent=2)
+        print("bench: tunnel diagnostic follows\n" + blob,
+              file=sys.stderr)
+        fname = time.strftime("BENCH_DIAG_%Y%m%d_%H%M%S.json")
+        with open(os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), fname), "w") as f:
+            f.write(blob + "\n")
+    except Exception as exc:  # noqa: BLE001 — diagnostics best-effort
+        print(f"bench: diagnostic itself failed: {exc}",
+              file=sys.stderr)
     sys.exit(1)
 
 

@@ -29,7 +29,7 @@ EOF
   make -C src/c_train
   # the native JPEG batch decoder: force a clean SELF-build into the
   # package lib dir — the path the runtime actually loads from
-  rm -f incubator_mxnet_tpu/lib/libmxtpu_imgdec.so
+  rm -f incubator_mxnet_tpu/lib/libmxtpu_imgdec*.so
   python - <<'EOF'
 from incubator_mxnet_tpu.image import native_dec
 assert native_dec.available(), "native image decoder failed to build"

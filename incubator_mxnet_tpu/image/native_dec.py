@@ -15,38 +15,59 @@ _LIB = None
 _TRIED = False
 
 
+def _build(src, so):
+    """Compile to a temp file and os.rename into place: the rename is
+    atomic on the same filesystem, so a concurrent process (multi-
+    process launch, pytest-xdist) can never dlopen a half-written .so
+    and two builders cannot corrupt each other (advisor r4)."""
+    os.makedirs(os.path.dirname(so), exist_ok=True)
+    tmp = f"{so}.tmp.{os.getpid()}"
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-fPIC", "-std=c++17", "-shared",
+             "-o", tmp, src, "-ljpeg", "-lpthread"],
+            check=True, capture_output=True, timeout=180)
+        os.rename(tmp, so)
+        return True
+    except Exception:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        return False
+
+
 def _native_lib():
     global _LIB, _TRIED
     if _TRIED:
         return _LIB
     _TRIED = True
     here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    so = os.path.join(here, "lib", "libmxtpu_imgdec.so")
+    # ABI-versioned filename: bumping the suffix on an ABI change makes
+    # a stale cached build simply not found, instead of relying on a
+    # same-path reload (glibc dedups dlopen by pathname, so re-loading
+    # a rebuilt .so at the SAME path returns the old mapping)
+    so = os.path.join(here, "lib", "libmxtpu_imgdec.v2.so")
     src = os.path.join(os.path.dirname(here), "src", "imgdec",
                        "imgdec.cc")
-    if not os.path.exists(so) and os.path.exists(src):
-        try:
-            os.makedirs(os.path.dirname(so), exist_ok=True)
-            subprocess.run(
-                ["g++", "-O2", "-fPIC", "-std=c++17", "-shared",
-                 "-o", so, src, "-ljpeg", "-lpthread"],
-                check=True, capture_output=True, timeout=180)
-        except Exception:
-            return None
     if not os.path.exists(so):
-        return None
+        if not (os.path.exists(src) and _build(src, so)):
+            return None
     try:
         lib = ctypes.CDLL(so)
         lib.imgdec_last_error.restype = ctypes.c_char_p
-        lib.imgdec_batch.restype = ctypes.c_int
-        lib.imgdec_batch.argtypes = [
+        lib.imgdec_batch_err.restype = ctypes.c_int
+        lib.imgdec_batch_err.argtypes = [
             ctypes.POINTER(ctypes.c_void_p),
             ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
             ctypes.c_int, ctypes.c_int, ctypes.c_int,
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
-            ctypes.POINTER(ctypes.c_float), ctypes.c_int]
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int]
         _LIB = lib
-    except OSError:
+    except (OSError, AttributeError):
+        # AttributeError: symbol missing (a foreign/corrupt .so at the
+        # versioned path) — degrade to the PIL fallback, never crash
         return None
     return _LIB
 
@@ -85,12 +106,16 @@ def decode_batch(raws, out_hw, resize_short=0, mirror=None,
     if std is not None:
         std = np.ascontiguousarray(std, np.float32)
         svec = std.ctypes.data_as(ctypes.c_void_p)
-    failed = lib.imgdec_batch(
+    # per-call error buffer: a concurrent iterator's next batch can't
+    # clobber this batch's message (unlike the imgdec_last_error()
+    # global)
+    err = ctypes.create_string_buffer(512)
+    failed = lib.imgdec_batch_err(
         bufs, sizes, n, oh, ow, int(resize_short), mir, mvec, svec,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-        int(nthreads))
+        int(nthreads), err, len(err))
     if failed:
         raise ValueError(
             f"native decode failed for {failed}/{n} images: "
-            f"{lib.imgdec_last_error().decode()}")
+            f"{err.value.decode(errors='replace')}")
     return out

@@ -31,36 +31,44 @@
 
 namespace {
 
-/* first error wins; read from any thread (the per-worker
- * thread_local variant made imgdec_last_error() always empty) */
+/* legacy global for imgdec_last_error(); first error wins.  The real
+ * error path is per-batch (imgdec_batch_err's caller buffer) — this
+ * global is inherently racy across concurrent batches and kept only
+ * for ABI compat. */
 std::mutex g_err_mu;
 std::string g_err;
 
-void set_err(const char *msg) {
+void set_err(const std::string &msg) {
   std::lock_guard<std::mutex> lock(g_err_mu);
   if (g_err.empty()) g_err = msg;
 }
 
+/* error manager carrying the message in the per-decode struct, so a
+ * failure is attributable to ITS image/batch with no shared state */
 struct ErrMgr {
   jpeg_error_mgr pub;
   jmp_buf jb;
+  char msg[JMSG_LENGTH_MAX];
 };
 
 void err_exit(j_common_ptr cinfo) {
-  char msg[JMSG_LENGTH_MAX];
-  (*cinfo->err->format_message)(cinfo, msg);
-  set_err(msg);
-  longjmp(reinterpret_cast<ErrMgr *>(cinfo->err)->jb, 1);
+  ErrMgr *e = reinterpret_cast<ErrMgr *>(cinfo->err);
+  (*cinfo->err->format_message)(cinfo, e->msg);
+  longjmp(e->jb, 1);
 }
 
-/* decode one JPEG into an RGB byte buffer; returns false on error */
+/* decode one JPEG into an RGB byte buffer; returns false on error
+ * (message in *err) */
 bool decode_rgb(const uint8_t *buf, size_t size,
-                std::vector<uint8_t> *out, int *h, int *w) {
+                std::vector<uint8_t> *out, int *h, int *w,
+                std::string *err) {
   jpeg_decompress_struct cinfo;
   ErrMgr jerr;
+  jerr.msg[0] = '\0';
   cinfo.err = jpeg_std_error(&jerr.pub);
   jerr.pub.error_exit = err_exit;
   if (setjmp(jerr.jb)) {
+    if (err) *err = jerr.msg;
     jpeg_destroy_decompress(&cinfo);
     return false;
   }
@@ -113,10 +121,11 @@ void resize_bilinear(const uint8_t *src, int ih, int iw, float *dst,
 
 bool process_one(const uint8_t *buf, size_t size, int oh, int ow,
                  int resize_short, int mirror, const float *mean,
-                 const float *stdv, float *out /* 3*oh*ow CHW */) {
+                 const float *stdv, float *out /* 3*oh*ow CHW */,
+                 std::string *err) {
   std::vector<uint8_t> rgb;
   int ih = 0, iw = 0;
-  if (!decode_rgb(buf, size, &rgb, &ih, &iw)) return false;
+  if (!decode_rgb(buf, size, &rgb, &ih, &iw, err)) return false;
 
   std::vector<float> hwc(static_cast<size_t>(oh) * ow * 3);
   std::vector<uint8_t> tmp;
@@ -182,63 +191,98 @@ bool process_one(const uint8_t *buf, size_t size, int oh, int ow,
 
 extern "C" {
 
-const char *imgdec_last_error() { return g_err.c_str(); }
+const char *imgdec_last_error() {
+  std::lock_guard<std::mutex> lock(g_err_mu);
+  /* leaked on purpose: the returned pointer must outlive the lock */
+  static thread_local std::string snapshot;
+  snapshot = g_err;
+  return snapshot.c_str();
+}
 
 /* Decode n JPEGs into out (n, 3, oh, ow) float32 with an internal
  * thread pool.  bufs/sizes: per-image byte buffers; mirror: per-image
  * 0/1 flags or NULL; mean/stdv: 3 floats or NULL; resize_short: 0 to
  * disable.  Returns 0, or the number of failed images. */
-/* persistent worker pool: threads are created once (growing up to
- * the largest nthreads ever requested) and reused across batches.
- * Every index claim happens under the mutex — at ~1 ms/image decode
- * granularity the lock is uncontended, and it makes cross-batch
- * stale-worker races structurally impossible. */
+/* persistent worker pool, multi-batch: threads are created once
+ * (growing up to the largest nthreads ever requested) and serve a
+ * FIFO queue of per-call Batch contexts.  Concurrent imgdec_batch
+ * callers are the normal case (train + val ImageRecordIter producer
+ * threads; ctypes drops the GIL) — each call owns its own Batch, so
+ * batches interleave across the pool with no shared mutable state
+ * (r4 advisor HIGH: the single-batch pool let caller B overwrite
+ * caller A's in-flight task), and nobody waits on anyone else's
+ * whole batch (r5 review: a global batch lock stalled the train
+ * producer for the full val batch). */
+struct Batch {
+  const std::function<void(int)> *task;
+  int next = 0;
+  int total = 0;
+  int pending = 0;
+  std::condition_variable done_cv;
+};
+
 class Pool {
  public:
-  void run(int nthreads, int n, std::function<void(int)> task) {
+  void run(int nthreads, int n, const std::function<void(int)> &task) {
+    if (n <= 0) return;
+    Batch b;
+    b.task = &task;
+    b.total = b.pending = n;
     std::unique_lock<std::mutex> lock(mu_);
     while (nworkers_ < nthreads - 1) {
       std::thread([this] { loop(); }).detach();   // workers live for
       ++nworkers_;                                // the process
     }
-    task_ = std::move(task);
-    next_ = 0;
-    total_ = n;
-    pending_ = n;
+    queue_.push_back(&b);
     cv_.notify_all();
-    work(lock);       // the caller works too (nthreads == 1 case)
-    done_cv_.wait(lock, [this] { return pending_ == 0; });
-    task_ = nullptr;
-    total_ = 0;
+    work(lock, &b);   // the caller works its own batch too
+    b.done_cv.wait(lock, [&b] { return b.pending == 0; });
   }
 
  private:
+  Batch *pick() {   // lock held; FIFO across batches
+    for (Batch *b : queue_)
+      if (b->next < b->total) return b;
+    return nullptr;
+  }
+
   void loop() {
     std::unique_lock<std::mutex> lock(mu_);
     for (;;) {
-      cv_.wait(lock, [this] { return next_ < total_; });
-      work(lock);
+      cv_.wait(lock, [this] { return pick() != nullptr; });
+      work(lock, pick());
     }
   }
 
-  /* claims and runs items; enters and leaves with the lock HELD */
-  void work(std::unique_lock<std::mutex> &lock) {
-    while (next_ < total_) {
-      int i = next_++;
+  /* claims and runs items of ONE batch; enters/leaves with the lock
+   * HELD.  The batch object lives on its caller's stack; it stays in
+   * queue_ until its last item completes, and the caller cannot
+   * return before pending hits 0, so the pointer is always valid —
+   * including on the throw path: a throwing task is swallowed here
+   * (tasks report failure through their own state; see
+   * imgdec_batch_err) so pending ALWAYS reaches 0, the caller never
+   * unwinds with its Batch still queued, and a detached worker never
+   * hits std::terminate. */
+  void work(std::unique_lock<std::mutex> &lock, Batch *b) {
+    while (b->next < b->total) {
+      int i = b->next++;
       lock.unlock();
-      task_(i);
+      try {
+        (*b->task)(i);
+      } catch (...) {
+      }
       lock.lock();
-      if (--pending_ == 0) done_cv_.notify_all();
+      if (--b->pending == 0) {
+        queue_.erase(std::find(queue_.begin(), queue_.end(), b));
+        b->done_cv.notify_all();
+      }
     }
   }
 
   std::mutex mu_;
-  std::condition_variable cv_, done_cv_;
+  std::condition_variable cv_;
   int nworkers_ = 0;
-  std::function<void(int)> task_;
-  int next_ = 0;
-  int total_ = 0;
-  int pending_ = 0;
+  std::vector<Batch *> queue_;
 };
 
 Pool &pool() {
@@ -248,27 +292,63 @@ Pool &pool() {
   return *p;
 }
 
+/* Like imgdec_batch, but the first decode error of THIS batch is
+ * copied into err[errcap].  Error state is per-batch (threaded
+ * through the libjpeg error manager), so concurrent batches cannot
+ * clobber each other's message the way the imgdec_last_error()
+ * global can. */
+int imgdec_batch_err(const uint8_t *const *bufs, const int64_t *sizes,
+                     int n, int oh, int ow, int resize_short,
+                     const uint8_t *mirror, const float *mean,
+                     const float *stdv, float *out, int nthreads,
+                     char *err, int errcap) {
+  std::atomic<int> failed(0);
+  std::mutex emu;          /* guards this batch's first error */
+  std::string emsg;
+  if (nthreads < 1) nthreads = 1;
+  nthreads = std::min(nthreads, n);
+  pool().run(nthreads, n, [&](int i) {
+    std::string e;
+    bool ok = false;
+    try {
+      ok = process_one(
+          bufs[i], static_cast<size_t>(sizes[i]), oh, ow,
+          resize_short, mirror ? mirror[i] : 0, mean, stdv,
+          out + static_cast<size_t>(i) * 3 * oh * ow, &e);
+    } catch (const std::exception &ex) {
+      /* e.g. bad_alloc from a header declaring 65500x65500: count it
+       * as a failed image, never unwind through the pool/C ABI */
+      e = ex.what();
+    } catch (...) {
+      e = "unknown exception in decode task";
+    }
+    if (!ok) {
+      failed.fetch_add(1);
+      std::lock_guard<std::mutex> lock(emu);
+      if (emsg.empty()) emsg = e.empty() ? "decode failed" : e;
+    }
+  });
+  if (failed.load()) set_err(emsg);   /* legacy global, best-effort */
+  if (err && errcap > 0) {
+    std::snprintf(err, static_cast<size_t>(errcap), "%s",
+                  emsg.c_str());
+  }
+  return failed.load();
+}
+
 int imgdec_batch(const uint8_t *const *bufs, const int64_t *sizes,
                  int n, int oh, int ow, int resize_short,
                  const uint8_t *mirror, const float *mean,
                  const float *stdv, float *out, int nthreads) {
   {
-    /* per-call error scope: the reported message must belong to THIS
-     * batch's failure, not a handled one from minutes ago */
+    /* legacy per-call error scope (racy across concurrent callers by
+     * construction; new clients use imgdec_batch_err) */
     std::lock_guard<std::mutex> lock(g_err_mu);
     g_err.clear();
   }
-  std::atomic<int> failed(0);
-  if (nthreads < 1) nthreads = 1;
-  nthreads = std::min(nthreads, n);
-  pool().run(nthreads, n, [&](int i) {
-    bool ok = process_one(
-        bufs[i], static_cast<size_t>(sizes[i]), oh, ow,
-        resize_short, mirror ? mirror[i] : 0, mean, stdv,
-        out + static_cast<size_t>(i) * 3 * oh * ow);
-    if (!ok) failed.fetch_add(1);
-  });
-  return failed.load();
+  return imgdec_batch_err(bufs, sizes, n, oh, ow, resize_short,
+                          mirror, mean, stdv, out, nthreads,
+                          nullptr, 0);
 }
 
 }  // extern "C"

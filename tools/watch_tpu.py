@@ -1,0 +1,137 @@
+"""Opportunistic TPU bench watcher (VERDICT r4 next-step 1c).
+
+The axon tunnel to the chip has been intermittent across rounds —
+alive for an early-morning window in rounds 2-3, dead since.  This
+watcher turns any future window into a *persisted, timestamped,
+driver-corroboratable* measurement instead of a missed chance:
+
+  loop:
+    cheap subprocess probe (hang-proof, short timeout)
+    if the chip answers:
+        run the FULL bench suite (resnet50, transformer, pipeline)
+        persist every JSON line to BENCH_opportunistic_<ts>.json
+        go quiet for --success-interval, then re-verify
+    else: sleep --interval and retry
+
+Run it detached for the whole session:
+    nohup python tools/watch_tpu.py >> tpu_watch.log 2>&1 &
+
+Artifacts land in the repo root with wall-clock timestamps; each
+entry records the probe latency and device_kind so a reviewer can
+check the window against the driver's own logs.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_PROBE_SRC = ("import jax, jax.numpy as jnp; d=jax.devices()[0]; "
+              "x=jax.device_put(jnp.ones((128,128),jnp.float32), d); "
+              "jax.block_until_ready(x@x); "
+              "print('PROBE_OK', d.platform, "
+              "getattr(d,'device_kind',''))")
+
+
+def probe(timeout_s):
+    t0 = time.time()
+    try:
+        r = subprocess.run([sys.executable, "-c", _PROBE_SRC],
+                           capture_output=True, text=True,
+                           timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return None, round(time.time() - t0, 1)
+    if r.returncode == 0 and "PROBE_OK" in r.stdout:
+        parts = r.stdout.split("PROBE_OK", 1)[1].split()
+        if parts and parts[0] != "cpu":
+            return " ".join(parts), round(time.time() - t0, 1)
+    return None, round(time.time() - t0, 1)
+
+
+def run_bench(mode, extra_env, timeout_s=1800):
+    env = dict(os.environ)
+    env.update(extra_env)
+    # the chip just answered — no need for a long patient window here
+    env.setdefault("MXTPU_PROBE_RETRIES", "2")
+    env.setdefault("MXTPU_PROBE_TIMEOUT", "240")
+    t0 = time.time()
+    try:
+        r = subprocess.run([sys.executable, "bench.py"], cwd=REPO,
+                           capture_output=True, text=True,
+                           timeout=timeout_s, env=env)
+        rc, out, err = r.returncode, r.stdout, r.stderr
+    except subprocess.TimeoutExpired as exc:
+        rc = 124
+        out = (exc.stdout or b"").decode("utf-8", "replace") \
+            if isinstance(exc.stdout, bytes) else (exc.stdout or "")
+        err = (exc.stderr or b"").decode("utf-8", "replace") \
+            if isinstance(exc.stderr, bytes) else (exc.stderr or "")
+    parsed = None
+    for line in reversed(out.strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+            break
+        except ValueError:
+            continue
+    return {"mode": mode, "rc": rc, "seconds": round(time.time() - t0, 1),
+            "result": parsed, "stderr_tail": err[-1500:]}
+
+
+def main():
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=float, default=300,
+                    help="seconds between probes while the chip is down")
+    ap.add_argument("--probe-timeout", type=float, default=150)
+    ap.add_argument("--success-interval", type=float, default=3600,
+                    help="seconds between suites while the chip is up")
+    ap.add_argument("--once", action="store_true",
+                    help="probe once; bench if up; exit")
+    args = ap.parse_args()
+
+    n = 0
+    while True:
+        n += 1
+        kind, took = probe(args.probe_timeout)
+        stamp = time.strftime("%Y-%m-%dT%H:%M:%S")
+        if kind is None:
+            print(f"[{stamp}] probe #{n}: chip down "
+                  f"(waited {took}s)", flush=True)
+            if args.once:
+                return 1
+            time.sleep(args.interval)
+            continue
+        print(f"[{stamp}] probe #{n}: CHIP UP ({kind}, "
+              f"probe {took}s) — running full suite", flush=True)
+        suite = {"ts": stamp, "device": kind, "probe_s": took,
+                 "runs": []}
+        for mode, env in [
+                ("resnet50", {}),
+                ("transformer", {"MXTPU_BENCH_MODEL": "transformer"}),
+                ("transformer_b128",
+                 {"MXTPU_BENCH_MODEL": "transformer",
+                  "MXTPU_BENCH_BATCH": "32"}),
+                ("resnet50_b128", {"MXTPU_BENCH_BATCH": "128"}),
+                ("pipeline", {"MXTPU_BENCH_MODEL": "pipeline"})]:
+            res = run_bench(mode, env)
+            suite["runs"].append(res)
+            ok = res["result"] is not None and res["rc"] == 0
+            print(f"    {mode}: rc={res['rc']} "
+                  f"{'OK ' + json.dumps(res['result']) if ok else 'FAILED'}",
+                  flush=True)
+            # persist INCREMENTALLY — a window can close mid-suite
+            fname = os.path.join(
+                REPO, time.strftime("BENCH_opportunistic_%Y%m%d.json"))
+            with open(fname, "w") as f:
+                json.dump(suite, f, indent=2)
+        print(f"[{time.strftime('%Y-%m-%dT%H:%M:%S')}] suite done — "
+              f"persisted {fname}", flush=True)
+        if args.once:
+            return 0
+        time.sleep(args.success_interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main() or 0)
