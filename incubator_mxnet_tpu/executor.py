@@ -284,6 +284,12 @@ class Executor:
             self._monitor_cb(name, [NDArray(o, self._ctx)
                                     for o in outs])
 
+        from .utils.log import get_logger
+        get_logger().warning(
+            "Monitor armed: forward now runs un-jitted per-op tapped "
+            "evaluation (orders of magnitude slower than the fused "
+            "executable). Debug only; call set_monitor_callback(None) "
+            "/ Monitor uninstall to restore compiled speed.")
         self._monitor_cb = callback
         self._run_tapped = build_graph_fn(
             self._symbol, placements=self._placements,

@@ -18,6 +18,7 @@ float32 tolerance either way.
 """
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -252,8 +253,20 @@ def _flash_bwd(q, k, v, o, lse, g, causal, scale, interpret):
 
 def _supported(q, k):
     lq, lk = q.shape[1], k.shape[1]
-    return (q.ndim == 3 and lq % min(128, lq) == 0
-            and lk % min(128, lk) == 0)
+    if not (q.ndim == 3 and lq % min(128, lq) == 0
+            and lk % min(128, lk) == 0):
+        return False
+    # VMEM ceiling: the kernels stage whole-sequence operands per grid
+    # step (fwd/dq: full k+v; dkv: full q+g), i.e. ~2*L*D fp32 plus
+    # block-sized buffers.  VMEM is ~16 MB/core; past L*D ~ 2^20
+    # (8 MB staged) the backward stops fitting and Mosaic fails to
+    # compile or spills (advisor r4).  Longer sequences fall back to
+    # the XLA reference — ring attention (parallel/ring_attention.py)
+    # is the intended long-context path.
+    max_elems = int(os.environ.get("MXTPU_FLASH_MAX_STAGED_ELEMS",
+                                   2 ** 20))
+    d = q.shape[-1]
+    return max(lq, lk) * d <= max_elems
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))

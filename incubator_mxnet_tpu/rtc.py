@@ -190,6 +190,17 @@ def register(name, fn, *, vjp=None, arg_names=None,
         except (TypeError, ValueError):
             arg_names = []
         if not arg_names:
+            # compile_kernel wrappers expose only *arrays, so a
+            # multi-input kernel registered without explicit
+            # arg_names would silently become 1-ary symbolically
+            # (advisor r4) — tell the user how to fix it
+            import warnings
+            warnings.warn(
+                f"rtc.register({name!r}): cannot infer arg_names "
+                "from the function signature (it takes *arrays); "
+                "defaulting to ['data'] (single input).  Pass "
+                "arg_names=[...] explicitly for multi-input kernels "
+                "used symbolically.", stacklevel=2)
             arg_names = ["data"]
     op = OpDef(name, fn, num_outputs=num_outputs,
                arg_names=arg_names, differentiable=differentiable,

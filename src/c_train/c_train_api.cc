@@ -83,11 +83,28 @@ class _CTrain(object):
         self._bufs = {}
         self._params_blob = b""
         # loss semantics decided ONCE from the graph head, never from
-        # runtime output values: cross-entropy iff the head is a
-        # softmax classification output
+        # runtime output values.  Head kinds mirror the reference's
+        # loss-head operators (softmax_output.cc, regression_output.cc,
+        # make_loss.cc, svm_output.cc): each head implies what the
+        # reported scalar means.
         head_op = getattr(sym._heads[0][0], "op", None)
-        self._ce_loss = bool(self._label_names) and \
-            head_op is not None and head_op.name == "SoftmaxOutput"
+        head = head_op.name if head_op is not None else ""
+        if not self._label_names:
+            # MakeLoss-style: the output IS the loss
+            self._head_kind = "mean_output"
+        elif head == "SoftmaxOutput":
+            self._head_kind = "softmax_ce"
+        elif head == "LinearRegressionOutput":
+            self._head_kind = "mse"
+        elif head == "MAERegressionOutput":
+            self._head_kind = "mae"
+        elif head == "LogisticRegressionOutput":
+            self._head_kind = "binary_ce"
+        elif head == "SVMOutput":
+            self._head_kind = "hinge"
+        else:
+            # MakeLoss and unknown heads: the output IS the loss
+            self._head_kind = "mean_output"
 
     def set_input(self, key, mv, size):
         shape = self._shapes[key]
@@ -113,11 +130,33 @@ class _CTrain(object):
     def _loss(self):
         out = self._mod.get_outputs()[0].asnumpy() \
             .astype(np.float64)
-        if self._ce_loss:
+        kind = self._head_kind
+        if kind == "softmax_ce":
             # softmax head: mean cross-entropy vs first label
             y = self._bufs[self._label_names[0]].astype(int).ravel()
             p = out[np.arange(out.shape[0]), y]
             return float(-np.log(np.clip(p, 1e-12, None)).mean())
+        if kind == "hinge":
+            # SVMOutput (ops/nn.py svm_output): data (N, C), label
+            # (N,) class indices; sign matrix is +1 at the label
+            # column, -1 elsewhere.  Reported with the op's default
+            # margin=1, reg=1 (matching its backward's violations).
+            y = self._bufs[self._label_names[0]].astype(int).ravel()
+            ind = -np.ones_like(out)
+            ind[np.arange(out.shape[0]), y] = 1.0
+            return float(np.maximum(0.0, 1.0 - out * ind)
+                         .sum(axis=-1).mean())
+        if kind in ("mse", "mae", "binary_ce"):
+            y = self._bufs[self._label_names[0]] \
+                .astype(np.float64).reshape(out.shape)
+            if kind == "mse":
+                return float(((out - y) ** 2).mean())
+            if kind == "mae":
+                return float(np.abs(out - y).mean())
+            p = np.clip(out, 1e-12, 1 - 1e-12)
+            return float(-(y * np.log(p)
+                           + (1 - y) * np.log(1 - p)).mean())
+        # MakeLoss / unknown heads: the output IS the loss
         return float(out.mean())
 
     def forward(self):

@@ -52,8 +52,15 @@ int MXTPUTrainSetInput(TrainerHandle handle, const char *key,
 
 /* One training step on the current inputs: fused forward+backward+
  * optimizer update (one XLA executable after the first call).
- * *loss receives the mean loss (cross-entropy for softmax-style
- * heads, mean head output otherwise). */
+ * *loss receives the mean loss, whose meaning follows the graph's
+ * loss head (the reference's loss-head operator family):
+ *   SoftmaxOutput             -> mean cross-entropy vs the label
+ *   LinearRegressionOutput    -> mean squared error
+ *   MAERegressionOutput       -> mean absolute error
+ *   LogisticRegressionOutput  -> mean binary cross-entropy
+ *   SVMOutput                 -> mean hinge loss ({0,1} labels)
+ *   MakeLoss / label-free     -> mean head output (output IS the
+ *                                loss) */
 int MXTPUTrainStep(TrainerHandle handle, float *loss);
 
 /* Forward only (evaluation) on the current inputs. */

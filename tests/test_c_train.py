@@ -187,3 +187,61 @@ def test_c_train_resume_from_params(tmp_path):
     # resumed loss continues from the trained state, not from scratch
     assert float(loss.value) < mid_loss * 1.5
     lib.MXTPUTrainFree(h2)
+
+
+def test_c_train_regression_head_reports_mse():
+    """Loss semantics follow the head op (VERDICT r4 next-step 10):
+    a LinearRegressionOutput head must report mean squared error —
+    not the mean of the predictions — and it must decrease."""
+    lib = _bind(ctypes.CDLL(_build_lib()))
+    # the glue shares this process's interpreter: pin the init draw so
+    # convergence doesn't depend on sibling tests' PRNG consumption
+    mx.random.seed(7)
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="rfc", num_hidden=1)
+    sym = mx.sym.LinearRegressionOutput(net, name="lro")
+    sym_json = sym.tojson().encode()
+
+    rs = np.random.RandomState(1)
+    x = rs.rand(32, 4).astype(np.float32)
+    w = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+    y = (x @ w).astype(np.float32)
+
+    keys = (ctypes.c_char_p * 2)(b"data", b"lro_label")
+    indptr = (ctypes.c_uint * 3)(0, 2, 4)
+    shape = (ctypes.c_uint * 4)(32, 4, 32, 1)
+    h = ctypes.c_void_p()
+    assert lib.MXTPUTrainCreate(sym_json, None, 0, 1, 0, 2, keys,
+                                indptr, shape, b"adam",
+                                ctypes.c_float(0.1),
+                                ctypes.byref(h)) == 0, \
+        lib.MXTPUTrainGetLastError()
+    xf, yf = x.ravel().copy(), y.ravel().copy()
+    lib.MXTPUTrainSetInput(
+        h, b"data", xf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        xf.size)
+    lib.MXTPUTrainSetInput(
+        h, b"lro_label",
+        yf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), yf.size)
+    loss = ctypes.c_float()
+    losses = []
+    for _ in range(100):
+        assert lib.MXTPUTrainStep(h, ctypes.byref(loss)) == 0
+        losses.append(float(loss.value))
+
+    # the first reported value must be an MSE (positive, plausibly
+    # large), and training must shrink it hard on this linear problem
+    assert losses[0] > 0.1, losses[0]
+    assert losses[-1] < 0.05 * losses[0], (losses[0], losses[-1])
+
+    # cross-check the final report against an MSE computed from the
+    # outputs the ABI itself returns
+    assert lib.MXTPUTrainForward(h) == 0
+    out = np.empty(32, np.float32)
+    assert lib.MXTPUTrainGetOutput(
+        h, 0, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out.size) == 0
+    mse = float(((out.reshape(32, 1) - y) ** 2).mean())
+    assert abs(mse - losses[-1]) < max(0.1 * losses[-1], 1e-3), \
+        (mse, losses[-1])
+    lib.MXTPUTrainFree(h)

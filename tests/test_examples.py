@@ -70,3 +70,14 @@ def test_train_mnist_quick():
     import train_mnist as ex
     summary = ex.main(["--quick", "--num-epochs", "3"])
     assert summary["val_acc"] > 0.95
+
+
+def test_linear_classification_quick():
+    """Driver config 5 (sparse): row_sparse weight through KVStore
+    with O(touched-rows) pulls and lazy store-side SGD."""
+    import linear_classification as ex
+    summary = ex.main(["--quick"])
+    assert summary["final_nll"] < summary["first_nll"] * 0.65
+    assert summary["val_acc"] > 0.8
+    # the sparse pull must actually be saving traffic
+    assert summary["pull_savings"] > 0.25

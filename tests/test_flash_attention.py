@@ -156,3 +156,27 @@ def test_flash_backward_matches_reference_vjp():
                     np.asarray(got), np.asarray(want), rtol=2e-3,
                     atol=2e-3, err_msg=f"{name} causal={causal} "
                     f"shape={(bh, l, d)}")
+
+
+def test_long_sequence_exceeds_vmem_budget_falls_back(monkeypatch):
+    """The kernels stage whole-sequence operands (~2*L*D fp32) in
+    VMEM (~16 MB/core); past the staged-elements budget the Pallas
+    path must yield to the XLA reference instead of failing to
+    compile on hardware (advisor r4).  Budget is env-tunable."""
+    from incubator_mxnet_tpu.ops import flash as flash_mod
+
+    # shrink the budget so the check is testable at toy shapes
+    monkeypatch.setenv("MXTPU_FLASH_MAX_STAGED_ELEMS", str(256 * 16))
+    q, k, v = _rand(1, 256, 16)          # L*D == budget: supported
+    assert flash_mod._supported(q, k)
+    q2, k2, v2 = _rand(1, 512, 16)       # 2x budget: falls back
+    assert not flash_mod._supported(q2, k2)
+    out = flash_attention(q2, k2, v2, causal=True, interpret=True)
+    ref = _reference_attention(q2, k2, v2, True,
+                               1.0 / np.sqrt(16))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    # default budget admits the bench shapes (L=1024..8192, D=64..128)
+    monkeypatch.delenv("MXTPU_FLASH_MAX_STAGED_ELEMS")
+    q3, _, _ = _rand(1, 1024, 64)
+    assert flash_mod._supported(q3, q3)

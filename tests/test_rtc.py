@@ -160,3 +160,32 @@ def test_aliases_attach_and_unregister():
     rtc.register("test_rtc_primary", lambda x: x,
                  aliases=("test_rtc_alias",))
     rtc.unregister("test_rtc_primary")
+
+
+def test_register_warns_when_arg_names_uninferrable():
+    """compile_kernel wrappers take *arrays, so multi-input kernels
+    without explicit arg_names would silently register as 1-ary
+    symbolically (advisor r4) — the user gets a warning."""
+    import warnings as _warnings
+
+    def star_only(*arrays):
+        return arrays[0] + arrays[1]
+
+    with _warnings.catch_warnings(record=True) as rec:
+        _warnings.simplefilter("always")
+        rtc.register("test_rtc_star", star_only)
+    try:
+        assert any("arg_names" in str(w.message) for w in rec), \
+            [str(w.message) for w in rec]
+    finally:
+        rtc.unregister("test_rtc_star")
+
+    # explicit arg_names: no warning
+    with _warnings.catch_warnings(record=True) as rec:
+        _warnings.simplefilter("always")
+        rtc.register("test_rtc_star2", star_only,
+                     arg_names=["a", "b"])
+    try:
+        assert not any("arg_names" in str(w.message) for w in rec)
+    finally:
+        rtc.unregister("test_rtc_star2")
