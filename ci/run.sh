@@ -27,6 +27,8 @@ EOF
   make -C src/c_predict
   # the C training ABI (cpp-package analog)
   make -C src/c_train
+  # the general C API (NDArray / imperative invoke / KVStore)
+  make -C src/c_api
   # the native JPEG batch decoder: force a clean SELF-build into the
   # package lib dir — the path the runtime actually loads from
   rm -f incubator_mxnet_tpu/lib/libmxtpu_imgdec*.so

@@ -35,6 +35,27 @@ def is_initialized():
     return _initialized
 
 
+def _env_rank():
+    """Worker rank from the launch environment.
+
+    MXTPU_WORKER_RANK is the native contract (tools/launch.py local/
+    ssh modes).  Under `--launcher mpi` the launcher cannot know ranks
+    ahead of time — mpirun assigns them — so it sets
+    MXTPU_RANK_FROM_MPI=1 and the rank comes from the MPI runtime's
+    own env (OpenMPI/PMIx/MPICH/Slurm variants), the same contract
+    the reference's tracker relies on for its mpi mode."""
+    if os.environ.get("MXTPU_RANK_FROM_MPI") == "1":
+        for var in ("OMPI_COMM_WORLD_RANK", "PMIX_RANK", "PMI_RANK",
+                    "SLURM_PROCID"):
+            if var in os.environ:
+                return int(os.environ[var])
+        raise RuntimeError(
+            "MXTPU_RANK_FROM_MPI=1 but no MPI rank variable found "
+            "(OMPI_COMM_WORLD_RANK/PMIX_RANK/PMI_RANK/SLURM_PROCID) "
+            "— was this process actually started by mpirun?")
+    return int(os.environ.get("MXTPU_WORKER_RANK", "0"))
+
+
 def init(coordinator_address=None, num_workers_=None, rank_=None):
     """Join the distributed runtime (idempotent).
 
@@ -48,8 +69,7 @@ def init(coordinator_address=None, num_workers_=None, rank_=None):
     n = num_workers_ if num_workers_ is not None else env_num_workers()
     if n <= 1:
         return 0
-    r = rank_ if rank_ is not None else \
-        int(os.environ.get("MXTPU_WORKER_RANK", "0"))
+    r = rank_ if rank_ is not None else _env_rank()
     coord = coordinator_address or os.environ.get("MXTPU_COORD_ADDR")
     if coord is None:
         raise RuntimeError(

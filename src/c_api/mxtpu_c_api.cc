@@ -1,0 +1,557 @@
+/*
+ * General C API implementation (see mxtpu_c_api.h; ref role:
+ * src/c_api/c_api.cc + c_api_ndarray.cc).
+ *
+ * Same embedding design as ../c_predict and ../c_train: CPython is
+ * the marshalling layer, XLA executables are the compute path — an
+ * NDArrayHandle owns a framework NDArray whose buffer lives on the
+ * device, and op invocation dispatches through the same registry the
+ * Python frontends use, so the C surface can never drift from the
+ * Python one.  Every entry point takes the GIL, so C clients may
+ * call from any thread.
+ */
+#include "mxtpu_c_api.h"
+
+#include <Python.h>
+
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_last_error;
+
+const char *kGlueSource = R"PY(
+import ast
+
+import numpy as np
+
+try:
+    from incubator_mxnet_tpu.utils.platform import maybe_force_cpu
+    maybe_force_cpu()
+except Exception:
+    pass
+
+_DTYPES = {0: "float32", 1: "float64", 2: "float16", 3: "uint8",
+           4: "int32", 5: "int8", 6: "int64"}
+_FLAGS = {v: k for k, v in _DTYPES.items()}
+
+
+def _ctx(dev_type, dev_id):
+    import incubator_mxnet_tpu as mx
+    return mx.cpu(dev_id) if dev_type == 1 else mx.tpu(dev_id)
+
+
+def nd_create(shape, dtype_flag, dev_type, dev_id):
+    from incubator_mxnet_tpu import nd
+    if dtype_flag not in _DTYPES:
+        raise ValueError("unknown dtype flag %r" % (dtype_flag,))
+    return nd.zeros(tuple(int(d) for d in shape),
+                    ctx=_ctx(dev_type, dev_id),
+                    dtype=_DTYPES[dtype_flag])
+
+
+def nd_size_itemsize(arr):
+    return int(arr.size), int(np.dtype(arr.dtype).itemsize)
+
+
+def nd_copy_in(arr, mv, n):
+    import jax.numpy as jnp
+    if int(n) != int(arr.size):
+        raise ValueError("copy size %d != array size %d"
+                         % (n, arr.size))
+    src = np.frombuffer(mv, dtype=arr.dtype, count=int(n))
+    arr._data = jnp.asarray(src.reshape(arr.shape),
+                            dtype=arr._data.dtype)
+
+
+def nd_copy_out(arr, mv, n):
+    if int(n) != int(arr.size):
+        raise ValueError("copy size %d != array size %d"
+                         % (n, arr.size))
+    dst = np.frombuffer(mv, dtype=arr.dtype, count=int(n))
+    dst[:] = np.asarray(arr.asnumpy(), dtype=arr.dtype).ravel()
+
+
+def nd_shape(arr):
+    return tuple(int(d) for d in arr.shape)
+
+
+def nd_dtype_flag(arr):
+    name = np.dtype(arr.dtype).name
+    if name not in _FLAGS:
+        raise ValueError("dtype %r has no C flag" % (name,))
+    return _FLAGS[name]
+
+
+def nd_wait(arr):
+    arr.wait_to_read()
+
+
+def wait_all():
+    from incubator_mxnet_tpu import nd
+    nd.waitall()
+
+
+def list_op_names():
+    from incubator_mxnet_tpu.ops.registry import OPS
+    return sorted(OPS)
+
+
+def invoke(op_name, inputs, keys, vals):
+    from incubator_mxnet_tpu import nd
+    from incubator_mxnet_tpu.ops.registry import OPS
+    # membership in the op registry is the contract (the same set
+    # MXListAllOpNames reports) — NOT arbitrary nd-module attributes
+    if op_name not in OPS:
+        raise ValueError("unknown operator %r" % (op_name,))
+    fn = getattr(nd, op_name, None)
+    if fn is None:
+        raise ValueError(
+            "operator %r has no nd frontend" % (op_name,))
+    kwargs = {}
+    for k, v in zip(keys, vals):
+        try:
+            kwargs[k] = ast.literal_eval(v)
+        except (ValueError, SyntaxError):
+            kwargs[k] = v        # plain string parameter
+    out = fn(*inputs, **kwargs)
+    return list(out) if isinstance(out, (list, tuple)) else [out]
+
+
+def kv_create(kv_type):
+    import incubator_mxnet_tpu as mx
+    return mx.kv.create(kv_type)
+
+
+def kv_init(kv, keys, vals):
+    kv.init(list(keys), list(vals))
+
+
+def kv_push(kv, keys, vals, priority):
+    kv.push(list(keys), list(vals), priority=priority)
+
+
+def kv_pull(kv, keys, outs, priority):
+    kv.pull(list(keys), out=list(outs), priority=priority)
+
+
+def kv_set_optimizer(kv, name, lr):
+    import incubator_mxnet_tpu as mx
+    kv.set_optimizer(mx.optimizer.create(name, learning_rate=lr))
+)PY";
+
+PyObject *g_glue_ns = nullptr;
+bool g_owns_interpreter = false;
+
+struct NDHandle {
+  PyObject *obj;                 /* framework NDArray */
+  std::vector<mx_uint> shape;    /* last queried shape */
+};
+
+struct KVHandle {
+  PyObject *obj;                 /* framework KVStore */
+};
+
+class GIL {
+ public:
+  GIL() : state_(PyGILState_Ensure()) {}
+  ~GIL() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  g_last_error = "unknown python error";
+  if (value != nullptr) {
+    PyObject *s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char *c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) g_last_error = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+int ensure_runtime() {
+  static std::mutex mu;
+  std::lock_guard<std::mutex> lock(mu);
+  if (g_glue_ns != nullptr) return 0;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    g_owns_interpreter = true;
+    PyEval_SaveThread();
+  }
+  GIL gil;
+  PyObject *ns = PyDict_New();
+  if (ns == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  PyDict_SetItemString(ns, "__builtins__", PyEval_GetBuiltins());
+  PyObject *r = PyRun_String(kGlueSource, Py_file_input, ns, ns);
+  if (r == nullptr) {
+    set_error_from_python();
+    Py_DECREF(ns);
+    return -1;
+  }
+  Py_DECREF(r);
+  g_glue_ns = ns;
+  return 0;
+}
+
+/* call a glue function; returns new ref or nullptr w/ error set */
+PyObject *glue_call(const char *fn, const char *fmt, ...) {
+  PyObject *f = PyDict_GetItemString(g_glue_ns, fn);
+  if (f == nullptr) {
+    g_last_error = std::string("glue function missing: ") + fn;
+    return nullptr;
+  }
+  va_list va;
+  va_start(va, fmt);
+  PyObject *args = Py_VaBuildValue(fmt, va);
+  va_end(va);
+  if (args == nullptr) {
+    set_error_from_python();
+    return nullptr;
+  }
+  /* Py_BuildValue yields a tuple only for 2+ items */
+  if (!PyTuple_Check(args)) {
+    PyObject *t = PyTuple_Pack(1, args);
+    Py_DECREF(args);
+    args = t;
+    if (args == nullptr) {
+      set_error_from_python();
+      return nullptr;
+    }
+  }
+  PyObject *r = PyObject_CallObject(f, args);
+  Py_DECREF(args);
+  if (r == nullptr) set_error_from_python();
+  return r;
+}
+
+PyObject *str_list(mx_uint num, const char **strs) {
+  PyObject *l = PyList_New(num);
+  if (l == nullptr) return nullptr;
+  for (mx_uint i = 0; i < num; ++i) {
+    PyObject *s = PyUnicode_FromString(strs[i]);
+    if (s == nullptr) {
+      Py_DECREF(l);
+      return nullptr;
+    }
+    PyList_SET_ITEM(l, i, s);
+  }
+  return l;
+}
+
+PyObject *handle_list(mx_uint num, NDArrayHandle *handles) {
+  PyObject *l = PyList_New(num);
+  if (l == nullptr) return nullptr;
+  for (mx_uint i = 0; i < num; ++i) {
+    PyObject *o = static_cast<NDHandle *>(handles[i])->obj;
+    Py_INCREF(o);
+    PyList_SET_ITEM(l, i, o);
+  }
+  return l;
+}
+
+int nd_elem_bytes(NDHandle *h, size_t n, size_t *out_bytes) {
+  PyObject *r = glue_call("nd_size_itemsize", "(O)", h->obj);
+  if (r == nullptr) return -1;
+  size_t size = PyLong_AsSize_t(PyTuple_GET_ITEM(r, 0));
+  size_t item = PyLong_AsSize_t(PyTuple_GET_ITEM(r, 1));
+  Py_DECREF(r);
+  if (n != size) {
+    g_last_error = "element count mismatch: caller " +
+                   std::to_string(n) + ", array " +
+                   std::to_string(size);
+    return -1;
+  }
+  *out_bytes = n * item;
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char *MXTPUCApiGetLastError(void) {
+  return g_last_error.c_str();
+}
+
+int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim, int dtype,
+                    int dev_type, int dev_id, NDArrayHandle *out) {
+  if (ensure_runtime() != 0) return -1;
+  GIL gil;
+  PyObject *t = PyTuple_New(ndim);
+  if (t == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  for (mx_uint i = 0; i < ndim; ++i) {
+    PyTuple_SET_ITEM(t, i, PyLong_FromUnsignedLong(shape[i]));
+  }
+  PyObject *obj = glue_call("nd_create", "(Oiii)", t, dtype,
+                            dev_type, dev_id);
+  Py_DECREF(t);
+  if (obj == nullptr) return -1;
+  auto *h = new NDHandle();
+  h->obj = obj;
+  *out = h;
+  return 0;
+}
+
+int MXNDArrayGetSize(NDArrayHandle handle, size_t *out_size,
+                     size_t *out_itemsize) {
+  auto *h = static_cast<NDHandle *>(handle);
+  GIL gil;
+  PyObject *r = glue_call("nd_size_itemsize", "(O)", h->obj);
+  if (r == nullptr) return -1;
+  *out_size = PyLong_AsSize_t(PyTuple_GET_ITEM(r, 0));
+  *out_itemsize = PyLong_AsSize_t(PyTuple_GET_ITEM(r, 1));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                             size_t size) {
+  auto *h = static_cast<NDHandle *>(handle);
+  GIL gil;
+  size_t bytes = 0;
+  if (nd_elem_bytes(h, size, &bytes) != 0) return -1;
+  PyObject *mv = PyMemoryView_FromMemory(
+      static_cast<char *>(const_cast<void *>(data)),
+      static_cast<Py_ssize_t>(bytes), PyBUF_READ);
+  if (mv == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject *r = glue_call("nd_copy_in", "(OOn)", h->obj, mv,
+                          static_cast<Py_ssize_t>(size));
+  Py_DECREF(mv);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data,
+                           size_t size) {
+  auto *h = static_cast<NDHandle *>(handle);
+  GIL gil;
+  size_t bytes = 0;
+  if (nd_elem_bytes(h, size, &bytes) != 0) return -1;
+  PyObject *mv = PyMemoryView_FromMemory(
+      static_cast<char *>(data), static_cast<Py_ssize_t>(bytes),
+      PyBUF_WRITE);
+  if (mv == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject *r = glue_call("nd_copy_out", "(OOn)", h->obj, mv,
+                          static_cast<Py_ssize_t>(size));
+  Py_DECREF(mv);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_ndim,
+                      const mx_uint **out_data) {
+  auto *h = static_cast<NDHandle *>(handle);
+  GIL gil;
+  PyObject *t = glue_call("nd_shape", "(O)", h->obj);
+  if (t == nullptr) return -1;
+  h->shape.clear();
+  for (Py_ssize_t i = 0; i < PyTuple_Size(t); ++i) {
+    h->shape.push_back(static_cast<mx_uint>(
+        PyLong_AsUnsignedLong(PyTuple_GET_ITEM(t, i))));
+  }
+  Py_DECREF(t);
+  *out_ndim = static_cast<mx_uint>(h->shape.size());
+  *out_data = h->shape.data();
+  return 0;
+}
+
+int MXNDArrayGetDType(NDArrayHandle handle, int *out_dtype) {
+  auto *h = static_cast<NDHandle *>(handle);
+  GIL gil;
+  PyObject *r = glue_call("nd_dtype_flag", "(O)", h->obj);
+  if (r == nullptr) return -1;
+  *out_dtype = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayWaitToRead(NDArrayHandle handle) {
+  auto *h = static_cast<NDHandle *>(handle);
+  GIL gil;
+  PyObject *r = glue_call("nd_wait", "(O)", h->obj);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayWaitAll(void) {
+  if (ensure_runtime() != 0) return -1;
+  GIL gil;
+  PyObject *r = glue_call("wait_all", "()");
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayFree(NDArrayHandle handle) {
+  auto *h = static_cast<NDHandle *>(handle);
+  {
+    GIL gil;
+    Py_XDECREF(h->obj);
+  }
+  delete h;
+  return 0;
+}
+
+int MXListAllOpNames(mx_uint *out_size, const char ***out_array) {
+  if (ensure_runtime() != 0) return -1;
+  GIL gil;
+  /* process-lifetime storage (the reference returns arena pointers
+   * with the same contract) */
+  static std::vector<std::string> names;
+  static std::vector<const char *> ptrs;
+  if (ptrs.empty()) {
+    PyObject *l = glue_call("list_op_names", "()");
+    if (l == nullptr) return -1;
+    for (Py_ssize_t i = 0; i < PyList_Size(l); ++i) {
+      names.emplace_back(
+          PyUnicode_AsUTF8(PyList_GET_ITEM(l, i)));
+    }
+    Py_DECREF(l);
+    for (const auto &n : names) ptrs.push_back(n.c_str());
+  }
+  *out_size = static_cast<mx_uint>(ptrs.size());
+  *out_array = ptrs.data();
+  return 0;
+}
+
+int MXImperativeInvoke(const char *op_name, int num_inputs,
+                       NDArrayHandle *inputs, int *num_outputs,
+                       NDArrayHandle *outputs, int num_params,
+                       const char **param_keys,
+                       const char **param_vals) {
+  if (ensure_runtime() != 0) return -1;
+  GIL gil;
+  PyObject *ins = handle_list(num_inputs, inputs);
+  PyObject *keys = str_list(num_params, param_keys);
+  PyObject *vals = str_list(num_params, param_vals);
+  PyObject *r = (ins && keys && vals)
+                    ? glue_call("invoke", "(sOOO)", op_name, ins,
+                                keys, vals)
+                    : nullptr;
+  if (r == nullptr && PyErr_Occurred()) set_error_from_python();
+  Py_XDECREF(ins);
+  Py_XDECREF(keys);
+  Py_XDECREF(vals);
+  if (r == nullptr) return -1;
+  Py_ssize_t n = PyList_Size(r);
+  if (n > *num_outputs) {
+    g_last_error = "op produced " + std::to_string(n) +
+                   " outputs, caller buffer holds " +
+                   std::to_string(*num_outputs);
+    Py_DECREF(r);
+    return -1;
+  }
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    auto *h = new NDHandle();
+    h->obj = PyList_GET_ITEM(r, i);
+    Py_INCREF(h->obj);
+    outputs[i] = h;
+  }
+  Py_DECREF(r);
+  *num_outputs = static_cast<int>(n);
+  return 0;
+}
+
+int MXKVStoreCreate(const char *type, KVStoreHandle *out) {
+  if (ensure_runtime() != 0) return -1;
+  GIL gil;
+  PyObject *obj = glue_call("kv_create", "(s)", type);
+  if (obj == nullptr) return -1;
+  auto *h = new KVHandle();
+  h->obj = obj;
+  *out = h;
+  return 0;
+}
+
+int MXKVStoreFree(KVStoreHandle handle) {
+  auto *h = static_cast<KVHandle *>(handle);
+  {
+    GIL gil;
+    Py_XDECREF(h->obj);
+  }
+  delete h;
+  return 0;
+}
+
+static int kv_call3(const char *fn, KVStoreHandle handle,
+                    mx_uint num, const char **keys,
+                    NDArrayHandle *vals, int priority,
+                    bool with_priority) {
+  auto *h = static_cast<KVHandle *>(handle);
+  GIL gil;
+  PyObject *ks = str_list(num, keys);
+  PyObject *vs = handle_list(num, vals);
+  PyObject *r = nullptr;
+  if (ks && vs) {
+    r = with_priority
+            ? glue_call(fn, "(OOOi)", h->obj, ks, vs, priority)
+            : glue_call(fn, "(OOO)", h->obj, ks, vs);
+  } else if (PyErr_Occurred()) {
+    set_error_from_python();
+  }
+  Py_XDECREF(ks);
+  Py_XDECREF(vs);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreInitEx(KVStoreHandle handle, mx_uint num,
+                    const char **keys, NDArrayHandle *vals) {
+  return kv_call3("kv_init", handle, num, keys, vals, 0, false);
+}
+
+int MXKVStorePushEx(KVStoreHandle handle, mx_uint num,
+                    const char **keys, NDArrayHandle *vals,
+                    int priority) {
+  return kv_call3("kv_push", handle, num, keys, vals, priority,
+                  true);
+}
+
+int MXKVStorePullEx(KVStoreHandle handle, mx_uint num,
+                    const char **keys, NDArrayHandle *outs,
+                    int priority) {
+  return kv_call3("kv_pull", handle, num, keys, outs, priority,
+                  true);
+}
+
+int MXKVStoreSetOptimizer(KVStoreHandle handle, const char *name,
+                          float learning_rate) {
+  auto *h = static_cast<KVHandle *>(handle);
+  GIL gil;
+  PyObject *r = glue_call("kv_set_optimizer", "(Osf)", h->obj, name,
+                          learning_rate);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,127 @@
+/*
+ * General-purpose C API: NDArray creation/IO, imperative op
+ * invocation against the full operator registry, and KVStore —
+ * the core subset of the reference's 162-function C surface
+ * (ref: include/mxnet/c_api.h — MXNDArrayCreate c_api.cc:174,
+ * MXImperativeInvoke c_api_ndarray.cc:131, MXKVStoreCreate
+ * c_api.cc:744).
+ *
+ * Unlike the canned predict/train ABIs (c_predict_api.h,
+ * c_train_api.h), this surface lets a native client COMPOSE:
+ * build tensors, call any registered operator, and synchronize
+ * parameters — no Python in the client code.
+ *
+ * Conventions: every call returns 0 on success, -1 on failure with
+ * the message available from MXTPUCApiGetLastError() (thread-local).
+ * All entry points are thread-safe (GIL taken internally).
+ */
+#ifndef MXTPU_C_API_H_
+#define MXTPU_C_API_H_
+
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef unsigned int mx_uint;
+typedef void *NDArrayHandle;
+typedef void *KVStoreHandle;
+
+/* dtype flags (the reference's mshadow TypeFlag order) */
+#define MXTPU_DTYPE_FLOAT32 0
+#define MXTPU_DTYPE_FLOAT64 1
+#define MXTPU_DTYPE_FLOAT16 2
+#define MXTPU_DTYPE_UINT8 3
+#define MXTPU_DTYPE_INT32 4
+#define MXTPU_DTYPE_INT8 5
+#define MXTPU_DTYPE_INT64 6
+
+/* device types (ref: Context::kCPU=1, accelerator=2) */
+#define MXTPU_DEV_CPU 1
+#define MXTPU_DEV_TPU 2
+
+const char *MXTPUCApiGetLastError(void);
+
+/* ---------------------------------------------------------- NDArray */
+
+/* Zero-initialized array on the given device. */
+int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim, int dtype,
+                    int dev_type, int dev_id, NDArrayHandle *out);
+
+/* Element count and bytes-per-element of the array. */
+int MXNDArrayGetSize(NDArrayHandle handle, size_t *out_size,
+                     size_t *out_itemsize);
+
+/* Blocking host->device / device->host copies; `size` counts
+ * ELEMENTS of the array's dtype and must equal the array size
+ * (ref: MXNDArraySyncCopyFromCPU). */
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                             size_t size);
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data,
+                           size_t size);
+
+/* Shape query; pointers valid until the next call on this handle. */
+int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_ndim,
+                      const mx_uint **out_data);
+int MXNDArrayGetDType(NDArrayHandle handle, int *out_dtype);
+
+/* Block until this array's pending computation is done / until all
+ * dispatched work is done (ref: MXNDArrayWaitToRead/WaitAll). */
+int MXNDArrayWaitToRead(NDArrayHandle handle);
+int MXNDArrayWaitAll(void);
+
+int MXNDArrayFree(NDArrayHandle handle);
+
+/* -------------------------------------------------- operator invoke */
+
+/* Names of every registered operator; pointers are owned by the
+ * library and stay valid for the process lifetime
+ * (ref: MXListAllOpNames). */
+int MXListAllOpNames(mx_uint *out_size, const char ***out_array);
+
+/* Invoke a registered operator imperatively
+ * (ref: MXImperativeInvoke, c_api_ndarray.cc:131).
+ *   op_name     : registry name ("dot", "broadcast_add", "relu", ...)
+ *   inputs      : num_inputs NDArray handles, positional
+ *   param_keys/param_vals : num_params keyword parameters as strings;
+ *     values are parsed as Python literals ("2", "(1, 2)", "true"
+ *     is spelled "True") with plain-string fallback
+ *   num_outputs : in: capacity of `outputs`; out: number produced
+ *   outputs     : receives new handles (caller frees each) */
+int MXImperativeInvoke(const char *op_name, int num_inputs,
+                       NDArrayHandle *inputs, int *num_outputs,
+                       NDArrayHandle *outputs, int num_params,
+                       const char **param_keys,
+                       const char **param_vals);
+
+/* ---------------------------------------------------------- KVStore */
+
+/* type: "local" | "device" | "tpu" (ref: MXKVStoreCreate). */
+int MXKVStoreCreate(const char *type, KVStoreHandle *out);
+int MXKVStoreFree(KVStoreHandle handle);
+
+/* String-keyed init/push/pull (ref: MXKVStoreInitEx/PushEx/PullEx).
+ * With no optimizer set, pull after push returns the aggregated
+ * gradient; after MXKVStoreSetOptimizer, push applies the update
+ * store-side and pull returns the current weights. */
+int MXKVStoreInitEx(KVStoreHandle handle, mx_uint num,
+                    const char **keys, NDArrayHandle *vals);
+int MXKVStorePushEx(KVStoreHandle handle, mx_uint num,
+                    const char **keys, NDArrayHandle *vals,
+                    int priority);
+int MXKVStorePullEx(KVStoreHandle handle, mx_uint num,
+                    const char **keys, NDArrayHandle *outs,
+                    int priority);
+
+/* Run the named optimizer store-side on every push
+ * (ref: MXKVStoreSetOptimizer — the reference pickles the optimizer
+ * to the servers; here it runs in-process). */
+int MXKVStoreSetOptimizer(KVStoreHandle handle, const char *name,
+                          float learning_rate);
+
+#ifdef __cplusplus
+}  /* extern "C" */
+#endif
+
+#endif  /* MXTPU_C_API_H_ */

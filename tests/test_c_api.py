@@ -1,0 +1,337 @@
+"""General C API (ref role: the core NDArray + imperative-invoke +
+KVStore subset of include/mxnet/c_api.h's 162 functions —
+MXNDArrayCreate c_api.cc:174, MXImperativeInvoke
+c_api_ndarray.cc:131, MXKVStoreCreate c_api.cc:744).
+
+The headline test compiles a REAL C program against mxtpu_c_api.h:
+the client builds tensors, invokes registry operators, and drives
+KVStore with a store-side optimizer — zero Python in the client
+code."""
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SRC = os.path.join(REPO, "src", "c_api")
+SO = os.path.join(SRC, "libmxtpu_capi.so")
+
+
+def _build_lib():
+    if not os.path.exists(SO):
+        subprocess.run(["make", "-C", SRC], check=True,
+                       capture_output=True, timeout=300)
+    return SO
+
+
+def _bind(lib):
+    u, sz = ctypes.c_uint, ctypes.c_size_t
+    vp = ctypes.c_void_p
+    lib.MXTPUCApiGetLastError.restype = ctypes.c_char_p
+    lib.MXNDArrayCreate.argtypes = [
+        ctypes.POINTER(u), u, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.POINTER(vp)]
+    lib.MXNDArrayGetSize.argtypes = [vp, ctypes.POINTER(sz),
+                                     ctypes.POINTER(sz)]
+    lib.MXNDArraySyncCopyFromCPU.argtypes = [vp, ctypes.c_void_p, sz]
+    lib.MXNDArraySyncCopyToCPU.argtypes = [vp, ctypes.c_void_p, sz]
+    lib.MXNDArrayGetShape.argtypes = [
+        vp, ctypes.POINTER(u), ctypes.POINTER(ctypes.POINTER(u))]
+    lib.MXNDArrayGetDType.argtypes = [vp, ctypes.POINTER(ctypes.c_int)]
+    lib.MXNDArrayWaitToRead.argtypes = [vp]
+    lib.MXNDArrayFree.argtypes = [vp]
+    lib.MXListAllOpNames.argtypes = [
+        ctypes.POINTER(u),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_char_p))]
+    lib.MXImperativeInvoke.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(vp),
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(vp),
+        ctypes.c_int, ctypes.POINTER(ctypes.c_char_p),
+        ctypes.POINTER(ctypes.c_char_p)]
+    lib.MXKVStoreCreate.argtypes = [ctypes.c_char_p,
+                                    ctypes.POINTER(vp)]
+    lib.MXKVStoreFree.argtypes = [vp]
+    lib.MXKVStoreInitEx.argtypes = [vp, u,
+                                    ctypes.POINTER(ctypes.c_char_p),
+                                    ctypes.POINTER(vp)]
+    lib.MXKVStorePushEx.argtypes = [vp, u,
+                                    ctypes.POINTER(ctypes.c_char_p),
+                                    ctypes.POINTER(vp), ctypes.c_int]
+    lib.MXKVStorePullEx.argtypes = [vp, u,
+                                    ctypes.POINTER(ctypes.c_char_p),
+                                    ctypes.POINTER(vp), ctypes.c_int]
+    lib.MXKVStoreSetOptimizer.argtypes = [vp, ctypes.c_char_p,
+                                          ctypes.c_float]
+    return lib
+
+
+def _nd_from_np(lib, arr):
+    shape = (ctypes.c_uint * arr.ndim)(*arr.shape)
+    h = ctypes.c_void_p()
+    assert lib.MXNDArrayCreate(shape, arr.ndim, 0, 1, 0,
+                               ctypes.byref(h)) == 0, \
+        lib.MXTPUCApiGetLastError()
+    flat = np.ascontiguousarray(arr, np.float32).ravel()
+    assert lib.MXNDArraySyncCopyFromCPU(
+        h, flat.ctypes.data_as(ctypes.c_void_p), flat.size) == 0, \
+        lib.MXTPUCApiGetLastError()
+    return h
+
+
+def _np_from_nd(lib, h):
+    ndim, pdata = ctypes.c_uint(), ctypes.POINTER(ctypes.c_uint)()
+    assert lib.MXNDArrayGetShape(h, ctypes.byref(ndim),
+                                 ctypes.byref(pdata)) == 0
+    shape = tuple(pdata[i] for i in range(ndim.value))
+    out = np.empty(int(np.prod(shape)) if shape else 1, np.float32)
+    assert lib.MXNDArraySyncCopyToCPU(
+        h, out.ctypes.data_as(ctypes.c_void_p), out.size) == 0, \
+        lib.MXTPUCApiGetLastError()
+    return out.reshape(shape)
+
+
+def test_ndarray_create_copy_shape_dtype():
+    lib = _bind(ctypes.CDLL(_build_lib()))
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    h = _nd_from_np(lib, x)
+    size, item = ctypes.c_size_t(), ctypes.c_size_t()
+    assert lib.MXNDArrayGetSize(h, ctypes.byref(size),
+                                ctypes.byref(item)) == 0
+    assert (size.value, item.value) == (12, 4)
+    dt = ctypes.c_int()
+    assert lib.MXNDArrayGetDType(h, ctypes.byref(dt)) == 0
+    assert dt.value == 0          # float32
+    assert lib.MXNDArrayWaitToRead(h) == 0
+    np.testing.assert_array_equal(_np_from_nd(lib, h), x)
+    # size mismatch is a clean error, not a crash
+    bad = np.zeros(5, np.float32)
+    assert lib.MXNDArraySyncCopyFromCPU(
+        h, bad.ctypes.data_as(ctypes.c_void_p), bad.size) == -1
+    assert b"mismatch" in lib.MXTPUCApiGetLastError()
+    lib.MXNDArrayFree(h)
+
+
+def test_imperative_invoke_ops():
+    lib = _bind(ctypes.CDLL(_build_lib()))
+    rs = np.random.RandomState(0)
+    a = rs.rand(4, 5).astype(np.float32)
+    b = rs.rand(5, 3).astype(np.float32)
+    ha, hb = _nd_from_np(lib, a), _nd_from_np(lib, b)
+
+    ins = (ctypes.c_void_p * 2)(ha, hb)
+    outs = (ctypes.c_void_p * 4)()
+    n_out = ctypes.c_int(4)
+    assert lib.MXImperativeInvoke(b"dot", 2, ins,
+                                  ctypes.byref(n_out), outs, 0,
+                                  None, None) == 0, \
+        lib.MXTPUCApiGetLastError()
+    assert n_out.value == 1
+    np.testing.assert_allclose(_np_from_nd(lib, outs[0]), a @ b,
+                               rtol=1e-5)
+    lib.MXNDArrayFree(outs[0])
+
+    # keyword parameters travel as literal strings
+    ins1 = (ctypes.c_void_p * 1)(ha)
+    keys = (ctypes.c_char_p * 1)(b"axis")
+    vals = (ctypes.c_char_p * 1)(b"1")
+    n_out.value = 4
+    assert lib.MXImperativeInvoke(b"sum", 1, ins1,
+                                  ctypes.byref(n_out), outs, 1,
+                                  keys, vals) == 0, \
+        lib.MXTPUCApiGetLastError()
+    np.testing.assert_allclose(_np_from_nd(lib, outs[0]),
+                               a.sum(axis=1), rtol=1e-5)
+    lib.MXNDArrayFree(outs[0])
+
+    # unknown op: clean error
+    n_out.value = 4
+    assert lib.MXImperativeInvoke(b"no_such_op", 1, ins1,
+                                  ctypes.byref(n_out), outs, 0,
+                                  None, None) == -1
+    assert b"no_such_op" in lib.MXTPUCApiGetLastError()
+    lib.MXNDArrayFree(ha)
+    lib.MXNDArrayFree(hb)
+
+
+def test_list_all_op_names():
+    lib = _bind(ctypes.CDLL(_build_lib()))
+    n, arr = ctypes.c_uint(), ctypes.POINTER(ctypes.c_char_p)()
+    assert lib.MXListAllOpNames(ctypes.byref(n),
+                                ctypes.byref(arr)) == 0
+    names = {arr[i].decode() for i in range(n.value)}
+    assert n.value > 250
+    for must in ("dot", "Convolution", "FullyConnected", "relu",
+                 "BatchNorm", "adam_update"):
+        assert must in names, must
+
+
+def test_kvstore_round_trip_with_optimizer():
+    lib = _bind(ctypes.CDLL(_build_lib()))
+    w = np.ones((4, 2), np.float32) * 2.0
+    g = np.full((4, 2), 0.5, np.float32)
+    hw, hg = _nd_from_np(lib, w), _nd_from_np(lib, g)
+
+    kv = ctypes.c_void_p()
+    assert lib.MXKVStoreCreate(b"local", ctypes.byref(kv)) == 0
+    keys = (ctypes.c_char_p * 1)(b"w")
+    vals = (ctypes.c_void_p * 1)(hw)
+    assert lib.MXKVStoreInitEx(kv, 1, keys, vals) == 0, \
+        lib.MXTPUCApiGetLastError()
+    assert lib.MXKVStoreSetOptimizer(kv, b"sgd",
+                                     ctypes.c_float(0.1)) == 0
+    grads = (ctypes.c_void_p * 1)(hg)
+    assert lib.MXKVStorePushEx(kv, 1, keys, grads, 0) == 0, \
+        lib.MXTPUCApiGetLastError()
+    out_h = _nd_from_np(lib, np.zeros((4, 2), np.float32))
+    outs = (ctypes.c_void_p * 1)(out_h)
+    assert lib.MXKVStorePullEx(kv, 1, keys, outs, 0) == 0, \
+        lib.MXTPUCApiGetLastError()
+    np.testing.assert_allclose(_np_from_nd(lib, out_h),
+                               w - 0.1 * g, rtol=1e-5)
+    for h in (hw, hg, out_h):
+        lib.MXNDArrayFree(h)
+    lib.MXKVStoreFree(kv)
+
+
+DEMO_C = r"""
+/* Standalone C client for the general C API: composes a two-layer
+ * computation from registry ops and runs one SGD round through
+ * KVStore — no Python anywhere in this file. */
+#include <stdio.h>
+#include <stdlib.h>
+#include "mxtpu_c_api.h"
+
+static NDArrayHandle from_data(const float *vals, const mx_uint *shape,
+                               mx_uint ndim, size_t n) {
+    NDArrayHandle h;
+    if (MXNDArrayCreate(shape, ndim, MXTPU_DTYPE_FLOAT32,
+                        MXTPU_DEV_CPU, 0, &h) != 0 ||
+        MXNDArraySyncCopyFromCPU(h, vals, n) != 0) {
+        fprintf(stderr, "create: %s\n", MXTPUCApiGetLastError());
+        exit(1);
+    }
+    return h;
+}
+
+int main(void) {
+    /* x (2,3) @ w (3,2) -> relu -> sum -> scalar */
+    float xv[6] = {1, -2, 3, -4, 5, -6};
+    float wv[6] = {0.5, -0.5, 1.0, 1.0, -1.0, 0.25};
+    mx_uint xs[2] = {2, 3}, ws[2] = {3, 2};
+    NDArrayHandle x = from_data(xv, xs, 2, 6);
+    NDArrayHandle w = from_data(wv, ws, 2, 6);
+
+    NDArrayHandle ins[2]; NDArrayHandle outs[4]; int n_out = 4;
+    ins[0] = x; ins[1] = w;
+    if (MXImperativeInvoke("dot", 2, ins, &n_out, outs, 0,
+                           NULL, NULL) != 0) {
+        fprintf(stderr, "dot: %s\n", MXTPUCApiGetLastError());
+        return 1;
+    }
+    NDArrayHandle xw = outs[0];
+    n_out = 4;
+    if (MXImperativeInvoke("relu", 1, &xw, &n_out, outs, 0,
+                           NULL, NULL) != 0) {
+        fprintf(stderr, "relu: %s\n", MXTPUCApiGetLastError());
+        return 1;
+    }
+    NDArrayHandle r = outs[0];
+    n_out = 4;
+    if (MXImperativeInvoke("sum", 1, &r, &n_out, outs, 0,
+                           NULL, NULL) != 0) {
+        fprintf(stderr, "sum: %s\n", MXTPUCApiGetLastError());
+        return 1;
+    }
+    float total;
+    if (MXNDArraySyncCopyToCPU(outs[0], &total, 1) != 0) {
+        fprintf(stderr, "copy: %s\n", MXTPUCApiGetLastError());
+        return 1;
+    }
+    printf("SUM %.6f\n", total);
+
+    /* one KVStore SGD round on the weight */
+    KVStoreHandle kv;
+    if (MXKVStoreCreate("local", &kv) != 0 ||
+        MXKVStoreSetOptimizer(kv, "sgd", 0.5f) != 0) {
+        fprintf(stderr, "kv: %s\n", MXTPUCApiGetLastError());
+        return 1;
+    }
+    const char *keys[1] = {"w"};
+    NDArrayHandle vals[1] = {w};
+    /* init BEFORE the optimizer sees a push */
+    if (MXKVStoreInitEx(kv, 1, keys, vals) != 0) {
+        fprintf(stderr, "init: %s\n", MXTPUCApiGetLastError());
+        return 1;
+    }
+    float gv[6] = {1, 1, 1, 1, 1, 1};
+    NDArrayHandle grad = from_data(gv, ws, 2, 6);
+    NDArrayHandle g1[1]; g1[0] = grad;
+    if (MXKVStorePushEx(kv, 1, keys, g1, 0) != 0) {
+        fprintf(stderr, "push: %s\n", MXTPUCApiGetLastError());
+        return 1;
+    }
+    NDArrayHandle wout = from_data(gv, ws, 2, 6);  /* scratch */
+    NDArrayHandle o1[1]; o1[0] = wout;
+    if (MXKVStorePullEx(kv, 1, keys, o1, 0) != 0) {
+        fprintf(stderr, "pull: %s\n", MXTPUCApiGetLastError());
+        return 1;
+    }
+    float wnew[6];
+    MXNDArraySyncCopyToCPU(wout, wnew, 6);
+    for (int i = 0; i < 6; ++i) printf("W %.6f\n", wnew[i]);
+    MXKVStoreFree(kv);
+    MXNDArrayWaitAll();
+    return 0;
+}
+"""
+
+
+def test_c_api_standalone_client(tmp_path):
+    """Compile a real C program against mxtpu_c_api.h and run it with
+    a fresh embedded interpreter: tensors, ops, and KVStore all
+    driven from C."""
+    _build_lib()
+    demo_c = tmp_path / "demo.c"
+    demo_c.write_text(DEMO_C)
+    demo = str(tmp_path / "demo")
+    subprocess.run(
+        ["gcc", "-O2", "-I", SRC, str(demo_c), "-o", demo,
+         "-L", SRC, f"-Wl,-rpath,{SRC}", "-lmxtpu_capi"],
+        check=True, capture_output=True, timeout=120)
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["MXTPU_FORCE_CPU"] = "1"
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run([demo], capture_output=True, text=True,
+                       timeout=600, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = r.stdout.strip().splitlines()
+
+    # oracle in numpy
+    x = np.array([[1, -2, 3], [-4, 5, -6]], np.float32)
+    w = np.array([[0.5, -0.5], [1.0, 1.0], [-1.0, 0.25]], np.float32)
+    want_sum = np.maximum(x @ w, 0).sum()
+    got_sum = float(lines[0].split()[1])
+    assert abs(got_sum - want_sum) < 1e-4, (got_sum, want_sum)
+    got_w = np.array([float(l.split()[1]) for l in lines[1:7]],
+                     np.float32).reshape(3, 2)
+    np.testing.assert_allclose(got_w, w - 0.5, rtol=1e-5)
+
+
+def test_invoke_rejects_non_registry_attributes():
+    """MXImperativeInvoke's contract is the op registry (what
+    MXListAllOpNames reports) — module helpers on the nd namespace
+    like 'array'/'waitall' must be rejected, not called."""
+    lib = _bind(ctypes.CDLL(_build_lib()))
+    x = _nd_from_np(lib, np.zeros((2, 2), np.float32))
+    ins = (ctypes.c_void_p * 1)(x)
+    outs = (ctypes.c_void_p * 4)()
+    for name in (b"array", b"waitall", b"zeros"):
+        n_out = ctypes.c_int(4)
+        assert lib.MXImperativeInvoke(name, 1, ins,
+                                      ctypes.byref(n_out), outs, 0,
+                                      None, None) == -1, name
+        assert b"unknown operator" in lib.MXTPUCApiGetLastError()
+    lib.MXNDArrayFree(x)

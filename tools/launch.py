@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""Distributed job launcher (ref: tools/launch.py:64-83).
+"""Distributed job launcher (ref: tools/launch.py:64-83 — the dmlc
+tracker's ssh/local submission modes).
 
 Spawns N worker processes for data-parallel training.  Where the
 reference wires ps-lite (scheduler + servers + workers over DMLC_*
@@ -9,24 +10,40 @@ worker gets the coordinator address of rank 0 and joins via
 `kvstore.create('dist_sync')`).
 
 Usage:
+    # N processes on this host
     python tools/launch.py -n 2 python train.py --kv-store dist_sync
+
+    # N processes across the hosts in a hostfile, over ssh
+    python tools/launch.py -n 8 -H hosts --launcher ssh \
+        python train.py --kv-store dist_sync
 
 Launch modes:
     local (default) — N processes on this host (the reference's
         `--launcher local` used by tests/nightly/dist_sync_kvstore.py)
-    ssh/mpi/sge/yarn — print the equivalent command per host; actual
-        remote spawning is environment-specific and out of scope here
-        (the reference shells out to ssh/mpirun the same way).
+    ssh — one ssh session per worker, ranks assigned round-robin over
+        the hostfile (lines: "host [slots]"); rank 0's host serves as
+        the coordinator on --port.  Env is propagated inline in the
+        remote command (MXTPU_*, PYTHONPATH, plus any --env KEY=VAL),
+        like the reference's tracker exports DMLC_* over ssh
+        (ref: dmlc_tracker/ssh.py role).  --ssh-cmd substitutes the
+        transport (tests use a local shim; GCE TPU pods use
+        `gcloud compute tpus tpu-vm ssh` — see README).
+    mpi — exec mpirun with -x env forwarding when mpirun exists.
+    sge/yarn — print the per-host commands (documented de-scope:
+        those schedulers' submission APIs are site-specific).
 
 `-s` (server count) is accepted for CLI parity and ignored: there are
 no parameter servers in the collective design.
 """
 import argparse
 import os
+import shlex
+import shutil
 import signal
 import socket
 import subprocess
 import sys
+import time
 
 
 def _free_port():
@@ -35,6 +52,108 @@ def _free_port():
     port = s.getsockname()[1]
     s.close()
     return port
+
+
+def _parse_hostfile(path):
+    """Lines of "host" or "host slots"; '#' comments allowed."""
+    hosts = []
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            hosts.append((parts[0],
+                          int(parts[1]) if len(parts) > 1 else 1))
+    if not hosts:
+        raise ValueError(f"hostfile {path} lists no hosts")
+    return hosts
+
+
+def _assign_hosts(hosts, n):
+    """rank -> host, filling each host's slots before wrapping."""
+    pool = [h for h, slots in hosts for _ in range(slots)]
+    if not pool:
+        raise ValueError("hostfile has no usable slots (every host "
+                         "has 'slots' of 0)")
+    return [pool[r % len(pool)] for r in range(n)]
+
+
+def _worker_env(args, rank, coord, attempt):
+    env = {
+        "MXTPU_NUM_WORKERS": str(args.num_workers),
+        "MXTPU_WORKER_RANK": str(rank),
+        "MXTPU_COORD_ADDR": coord,
+        "MXTPU_RESTART_ATTEMPT": str(attempt),
+    }
+    for kv in args.env:
+        if "=" not in kv:
+            raise ValueError(f"--env wants KEY=VALUE, got {kv!r}")
+        k, v = kv.split("=", 1)
+        env[k] = v
+    return env
+
+
+def _ssh_argv(args, host, remote_cmd):
+    base = shlex.split(args.ssh_cmd)
+    if os.path.basename(base[0]) == "ssh":
+        # -tt: force a pty so tearing down the local ssh client HUPs
+        # the remote worker's process group — without it, killing ssh
+        # leaves the remote python alive, blocked in a collective and
+        # holding its TPU chips, and any elastic restart on the same
+        # hosts would find the devices taken
+        base += ["-tt", "-o", "BatchMode=yes",
+                 "-o", "StrictHostKeyChecking=no"]
+    return base + [host, remote_cmd]
+
+
+def _remote_command(args, rank, coord, attempt, cmd):
+    """One POSIX-shell line: cd to the launch cwd, export env inline,
+    exec the training command (the reference tracker's export+exec
+    pattern over ssh)."""
+    env = _worker_env(args, rank, coord, attempt)
+    if os.environ.get("PYTHONPATH"):
+        env.setdefault("PYTHONPATH", os.environ["PYTHONPATH"])
+    assigns = " ".join(f"{k}={shlex.quote(v)}"
+                       for k, v in sorted(env.items()))
+    prog = " ".join(shlex.quote(c) for c in cmd)
+    return (f"cd {shlex.quote(os.getcwd())} && "
+            f"{assigns} exec {prog}")
+
+
+def _run_once(spawners):
+    """Start every worker; first nonzero exit tears the job down (a
+    crashing worker mid-collective leaves peers blocked forever — the
+    reference's ps-lite scheduler dies the same way)."""
+    procs = []
+    try:
+        for spawn in spawners:
+            procs.append(spawn())
+        rc = 0
+        pending = dict(enumerate(procs))
+        while pending and rc == 0:
+            for r, p in list(pending.items()):
+                code = p.poll()
+                if code is None:
+                    continue
+                del pending[r]
+                if code != 0:
+                    print(f"launch.py: worker {r} exited with "
+                          f"{code}; terminating the job",
+                          file=sys.stderr)
+                    rc = code or 1
+            time.sleep(0.05)
+        return rc
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.time() + 10
+        for p in procs:
+            while p.poll() is None and time.time() < deadline:
+                time.sleep(0.05)
+            if p.poll() is None:
+                p.kill()
 
 
 def main():
@@ -48,7 +167,18 @@ def main():
     ap.add_argument("--launcher", default="local",
                     choices=["local", "ssh", "mpi", "sge", "yarn"])
     ap.add_argument("-H", "--hostfile", default=None,
-                    help="hostfile for ssh/mpi modes")
+                    help="hostfile for ssh/mpi modes: 'host [slots]' "
+                    "per line")
+    ap.add_argument("--port", type=int, default=29500,
+                    help="coordinator port on rank 0's host "
+                    "(ssh/mpi modes; local mode picks a free port)")
+    ap.add_argument("--ssh-cmd", default="ssh",
+                    help="remote-shell command for --launcher ssh "
+                    "(e.g. 'gcloud compute tpus tpu-vm ssh')")
+    ap.add_argument("--env", action="append", default=[],
+                    metavar="KEY=VALUE",
+                    help="extra env var to propagate to every worker "
+                    "(repeatable)")
     ap.add_argument("--max-restarts", type=int, default=0,
                     help="elastic mode: relaunch the whole job up to "
                     "N times after a worker failure (workers resume "
@@ -65,67 +195,86 @@ def main():
     if cmd and cmd[0] == "--":
         cmd = cmd[1:]
 
-    coord = f"127.0.0.1:{_free_port()}"
-    if args.launcher != "local":
-        print(f"# {args.launcher} mode: run on each host "
-              "(rank 0's host is the coordinator):")
-        for r in range(args.num_workers):
-            env = (f"MXTPU_NUM_WORKERS={args.num_workers} "
-                   f"MXTPU_WORKER_RANK={r} "
-                   f"MXTPU_COORD_ADDR=<rank0-host>:9999")
-            print(f"{env} {' '.join(cmd)}")
-        return 0
-
-    import time
-
-    def run_once(coord, attempt):
-        procs = []
-        try:
+    if args.launcher == "local":
+        def make_spawners(coord, attempt):
+            spawners = []
             for r in range(args.num_workers):
                 env = dict(os.environ)
-                env["MXTPU_NUM_WORKERS"] = str(args.num_workers)
-                env["MXTPU_WORKER_RANK"] = str(r)
-                env["MXTPU_COORD_ADDR"] = coord
-                env["MXTPU_RESTART_ATTEMPT"] = str(attempt)
-                procs.append(subprocess.Popen(cmd, env=env))
-            # poll all workers: one crashing mid-collective would
-            # leave its peers blocked forever, so the first failure
-            # tears the job down (the reference's ps-lite scheduler
-            # dies the same way when a worker drops)
-            rc = 0
-            pending = dict(enumerate(procs))
-            while pending and rc == 0:
-                for r, p in list(pending.items()):
-                    code = p.poll()
-                    if code is None:
-                        continue
-                    del pending[r]
-                    if code != 0:
-                        print(f"launch.py: worker {r} exited with "
-                              f"{code}; terminating the job",
-                              file=sys.stderr)
-                        rc = code or 1
-                time.sleep(0.05)
-            return rc
-        finally:
-            for p in procs:
-                if p.poll() is None:
-                    p.send_signal(signal.SIGTERM)
-            deadline = time.time() + 10
-            for p in procs:
-                while p.poll() is None and time.time() < deadline:
-                    time.sleep(0.05)
-                if p.poll() is None:
-                    p.kill()
+                env.update(_worker_env(args, r, coord, attempt))
 
-    rc = run_once(coord, 0)
+                def spawn(env=env):
+                    return subprocess.Popen(cmd, env=env)
+                spawners.append(spawn)
+            return spawners
+
+        def coord_for(attempt):
+            return f"127.0.0.1:{_free_port()}"
+
+    elif args.launcher == "ssh":
+        if not args.hostfile:
+            ap.error("--launcher ssh requires -H/--hostfile")
+        hosts = _parse_hostfile(args.hostfile)
+        ranks = _assign_hosts(hosts, args.num_workers)
+
+        def coord_for(attempt):
+            return f"{ranks[0]}:{args.port + attempt}"
+
+        def make_spawners(coord, attempt):
+            spawners = []
+            for r in range(args.num_workers):
+                argv = _ssh_argv(
+                    args, ranks[r],
+                    _remote_command(args, r, coord, attempt, cmd))
+
+                def spawn(argv=argv):
+                    return subprocess.Popen(argv)
+                spawners.append(spawn)
+            return spawners
+
+    elif args.launcher == "mpi":
+        mpirun = shutil.which("mpirun")
+        argv = ["mpirun", "-np", str(args.num_workers)]
+        # coordinator must live where mpirun places rank 0: with a
+        # hostfile that is its first host (mpirun fills hosts in
+        # order); otherwise single-host, this machine
+        coord_host = socket.gethostname()
+        if args.hostfile:
+            argv += ["--hostfile", args.hostfile]
+            coord_host = _parse_hostfile(args.hostfile)[0][0]
+        coord = f"{coord_host}:{args.port}"
+        env = _worker_env(args, -1, coord, 0)
+        env.pop("MXTPU_WORKER_RANK")
+        # ranks are assigned by the MPI runtime; dist.init() reads
+        # OMPI_COMM_WORLD_RANK/PMIX_RANK/PMI_RANK/SLURM_PROCID when
+        # this flag is set (dist._env_rank)
+        env["MXTPU_RANK_FROM_MPI"] = "1"
+        for k, v in sorted(env.items()):
+            argv += ["-x", f"{k}={v}"]
+        argv += cmd
+        if mpirun is None:
+            print("launch.py: mpirun not found; equivalent command:",
+                  file=sys.stderr)
+            print(" ".join(shlex.quote(a) for a in argv))
+            return 127
+        return subprocess.call(argv)
+
+    else:   # sge / yarn: site-specific submission APIs (documented)
+        coord = f"<rank0-host>:{args.port}"
+        print(f"# {args.launcher} mode: submit one task per line "
+              "(rank 0's host is the coordinator):")
+        for r in range(args.num_workers):
+            print(_remote_command(args, r, coord, 0, cmd))
+        return 0
+
+    coord = coord_for(0)
+    rc = _run_once(make_spawners(coord, 0))
     for attempt in range(1, args.max_restarts + 1):
         if rc == 0:
             break
         print(f"launch.py: restarting job (attempt {attempt}/"
               f"{args.max_restarts}); workers should resume from "
               "their last checkpoint", file=sys.stderr)
-        rc = run_once(f"127.0.0.1:{_free_port()}", attempt)
+        rc = _run_once(make_spawners(coord_for(attempt), attempt))
     return rc
 
 
