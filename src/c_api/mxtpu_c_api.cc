@@ -107,7 +107,10 @@ def invoke(op_name, inputs, keys, vals):
     # MXListAllOpNames reports) — NOT arbitrary nd-module attributes
     if op_name not in OPS:
         raise ValueError("unknown operator %r" % (op_name,))
-    fn = getattr(nd, op_name, None)
+    # underscore ops land on nd._internal (same layout as the
+    # reference's generated namespaces)
+    fn = getattr(nd, op_name, None) or \
+        getattr(nd._internal, op_name, None)
     if fn is None:
         raise ValueError(
             "operator %r has no nd frontend" % (op_name,))

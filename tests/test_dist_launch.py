@@ -217,3 +217,45 @@ def test_dist_rank_from_mpi_env(monkeypatch):
     monkeypatch.delenv("MXTPU_RANK_FROM_MPI")
     monkeypatch.setenv("MXTPU_WORKER_RANK", "2")
     assert dist._env_rank() == 2
+
+
+def test_kill_job_cleans_up_stuck_workers(tmp_path):
+    """tools/kill_job.py (the reference kill-mxnet.py role) walks the
+    hostfile over the launch transport and kills matching processes."""
+    import time
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    shim = _write_shim(tmp_path)
+    hostfile = tmp_path / "hosts"
+    hostfile.write_text("localhost 1\n")
+    log = tmp_path / "shim.log"
+
+    tag = f"mxtpu_stuck_{os.getpid()}"
+    stuck = subprocess.Popen(
+        [sys.executable, "-c",
+         f"import time  # {tag}\ntime.sleep(600)"])
+    try:
+        time.sleep(0.3)
+        assert stuck.poll() is None
+        env = dict(os.environ)
+        env["SSH_SHIM_LOG"] = str(log)
+        r = subprocess.run(
+            [sys.executable, os.path.join(repo, "tools",
+                                          "kill_job.py"),
+             "-H", str(hostfile), "--ssh-cmd", shim, tag],
+            capture_output=True, text=True, timeout=60, env=env)
+        assert r.returncode == 0, r.stdout + r.stderr
+        deadline = time.time() + 10
+        while stuck.poll() is None and time.time() < deadline:
+            time.sleep(0.1)
+        assert stuck.poll() is not None, "stuck worker survived"
+    finally:
+        if stuck.poll() is None:
+            stuck.kill()
+
+    # refuses self-matching patterns
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "kill_job.py"),
+         "launch.py"],
+        capture_output=True, text=True, timeout=30)
+    assert r.returncode != 0

@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Clean up a wedged distributed job (ref role: tools/kill-mxnet.py —
+ssh every host and kill the training processes).
+
+A crashed launcher or a worker stuck in a collective can leave
+processes holding TPU chips on every host.  This walks the same
+hostfile `tools/launch.py` used and kills every process whose command
+line matches the training program:
+
+    python tools/kill_job.py -H hosts train.py
+    python tools/kill_job.py train.py          # this host only
+
+Matching is by substring against the full command line (pkill -f
+semantics) but always guarded to processes running under the calling
+user.  --signal 9 escalates; --ssh-cmd swaps the transport exactly
+like launch.py (gcloud TPU-VM recipe in README).
+"""
+import argparse
+import getpass
+import os
+import re
+import shlex
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from launch import _parse_hostfile  # noqa: E402
+
+
+def _kill_cmd(pattern, sig):
+    """POSIX-shell line that kills every matching process EXCEPT the
+    kill machinery itself: the pattern appears in kill_job's own argv
+    and in the remote shell carrying this command, so a bare
+    `pkill -f` would take down its own ancestor chain."""
+    user = shlex.quote(getpass.getuser())
+    # pgrep -f matches an ERE; escape so the CLI keeps its documented
+    # substring semantics ('train[0].py' means those literal chars)
+    pat = shlex.quote(re.escape(pattern))
+    return (
+        f"for p in $(pgrep -u {user} -f {pat}); do "
+        "c=$(tr '\\0' ' ' < /proc/$p/cmdline 2>/dev/null); "
+        'case "$c" in '
+        "*kill_job*|*pgrep*|*pkill*) ;; "
+        f"*) kill -{sig} $p 2>/dev/null ;; "
+        "esac; done; true")
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="Kill a distributed training job's processes")
+    ap.add_argument("-H", "--hostfile", default=None,
+                    help="hostfile the job was launched with; "
+                    "default: this host only")
+    ap.add_argument("--signal", type=int, default=15,
+                    help="signal number (default SIGTERM; 9 = KILL)")
+    ap.add_argument("--ssh-cmd", default="ssh",
+                    help="remote-shell command (as in launch.py)")
+    ap.add_argument("pattern",
+                    help="substring of the training command line "
+                    "(e.g. the script name)")
+    args = ap.parse_args()
+
+    if "launch.py" in args.pattern or "kill_job" in args.pattern:
+        ap.error("pattern would match the launcher/killer itself; "
+                 "use the training script's name")
+
+    cmd = _kill_cmd(args.pattern, args.signal)
+    if not args.hostfile:
+        rc = subprocess.call(["sh", "-c", cmd])
+        print(f"localhost: {'ok' if rc == 0 else f'rc={rc}'}")
+        return 0
+
+    hosts = [h for h, _ in _parse_hostfile(args.hostfile)]
+    failures = 0
+    for host in hosts:
+        base = shlex.split(args.ssh_cmd)
+        if os.path.basename(base[0]) == "ssh":
+            base += ["-o", "BatchMode=yes",
+                     "-o", "StrictHostKeyChecking=no"]
+        try:
+            r = subprocess.run(base + [host, cmd],
+                               capture_output=True, text=True,
+                               timeout=60)
+            status = "ok" if r.returncode == 0 else \
+                f"rc={r.returncode}: {r.stderr.strip()[-200:]}"
+            failed = r.returncode != 0
+        except subprocess.TimeoutExpired:
+            # a dead host must not stop cleanup of the others
+            status = "timeout (host unreachable?)"
+            failed = True
+        print(f"{host}: {status}")
+        failures += failed
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

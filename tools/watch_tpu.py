@@ -50,7 +50,7 @@ def probe(timeout_s):
     return None, round(time.time() - t0, 1)
 
 
-def run_bench(mode, extra_env, timeout_s=1800):
+def run_bench(mode, extra_env, timeout_s=1800, script="bench.py"):
     env = dict(os.environ)
     env.update(extra_env)
     # the chip just answered — no need for a long patient window here
@@ -58,7 +58,7 @@ def run_bench(mode, extra_env, timeout_s=1800):
     env.setdefault("MXTPU_PROBE_TIMEOUT", "240")
     t0 = time.time()
     try:
-        r = subprocess.run([sys.executable, "bench.py"], cwd=REPO,
+        r = subprocess.run([sys.executable, script], cwd=REPO,
                            capture_output=True, text=True,
                            timeout=timeout_s, env=env)
         rc, out, err = r.returncode, r.stdout, r.stderr
@@ -107,23 +107,30 @@ def main():
               f"probe {took}s) — running full suite", flush=True)
         suite = {"ts": stamp, "device": kind, "probe_s": took,
                  "runs": []}
-        for mode, env in [
-                ("resnet50", {}),
-                ("transformer", {"MXTPU_BENCH_MODEL": "transformer"}),
-                ("transformer_b128",
+        # second-granular name: a later window the same day must not
+        # overwrite this one's results
+        fname = os.path.join(REPO, time.strftime(
+            "BENCH_opportunistic_%Y%m%d_%H%M%S.json"))
+        for mode, env, script in [
+                ("flash_compile", {},
+                 "tools/flash_compile_check.py"),
+                ("resnet50", {}, "bench.py"),
+                ("transformer", {"MXTPU_BENCH_MODEL": "transformer"},
+                 "bench.py"),
+                ("transformer_b32",
                  {"MXTPU_BENCH_MODEL": "transformer",
-                  "MXTPU_BENCH_BATCH": "32"}),
-                ("resnet50_b128", {"MXTPU_BENCH_BATCH": "128"}),
-                ("pipeline", {"MXTPU_BENCH_MODEL": "pipeline"})]:
-            res = run_bench(mode, env)
+                  "MXTPU_BENCH_BATCH": "32"}, "bench.py"),
+                ("resnet50_b128", {"MXTPU_BENCH_BATCH": "128"},
+                 "bench.py"),
+                ("pipeline", {"MXTPU_BENCH_MODEL": "pipeline"},
+                 "bench.py")]:
+            res = run_bench(mode, env, script=script)
             suite["runs"].append(res)
             ok = res["result"] is not None and res["rc"] == 0
             print(f"    {mode}: rc={res['rc']} "
                   f"{'OK ' + json.dumps(res['result']) if ok else 'FAILED'}",
                   flush=True)
             # persist INCREMENTALLY — a window can close mid-suite
-            fname = os.path.join(
-                REPO, time.strftime("BENCH_opportunistic_%Y%m%d.json"))
             with open(fname, "w") as f:
                 json.dump(suite, f, indent=2)
         print(f"[{time.strftime('%Y-%m-%dT%H:%M:%S')}] suite done — "
