@@ -6,6 +6,15 @@ online-softmax kernel (Flash Attention) that keeps the O(L^2) score
 matrix out of HBM — each (query-tile, key-tile) block is materialized
 only in VMEM, with running max/denominator carried across key tiles.
 
+STREAMING design (r5): the key/value (and in the backward, query)
+sequence walks through VMEM one block per grid step — the inner grid
+dimension is the tile loop, and the online-softmax carry (m, l, acc)
+lives in VMEM scratch that persists across grid steps (TPU grids are
+sequential).  VMEM use is O(block), independent of sequence length,
+so the same kernel covers the long-context regime; the earlier
+whole-sequence-staging version hit the ~16 MB VMEM wall near
+L*D ~ 2^20 (r4 advisor).
+
 Registered as the differentiable op ``_flash_attention`` so both the
 eager tape and compiled paths use it; the backward is the tiled
 FlashAttention recipe too — dq/dk/dv kernels rebuild each P tile from
@@ -18,7 +27,6 @@ float32 tolerance either way.
 """
 import functools
 import math
-import os
 
 import jax
 import jax.numpy as jnp
@@ -45,76 +53,90 @@ def _reference_attention(q, k, v, causal, scale):
                       v.astype(jnp.float32)).astype(q.dtype)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, nk,
-                causal, scale):
+def _causal_mask(s, iq, jk, bq, bk):
+    q_pos = iq * bq + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    k_pos = jk * bk + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(q_pos >= k_pos, s, _NEG)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc,
+                acc_sc, *, bq, bk, nk, causal, scale):
+    """grid = (BH, NQ, NK): one (q-tile, k-tile) block per step; the
+    k dimension is innermost, so the online-softmax carry streams
+    through the scratch accumulators."""
     from jax.experimental import pallas as pl
 
     iq = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale          # (BQ, D)
-    d = q.shape[-1]
-    m = jnp.full((bq, 1), _NEG, jnp.float32)
-    l = jnp.zeros((bq, 1), jnp.float32)
-    acc = jnp.zeros((bq, d), jnp.float32)
+    jk = pl.program_id(2)
 
-    def body(j, carry):
-        m, l, acc = carry
-        off = pl.multiple_of(j * bk, bk)   # aligned-slice hint (TPU)
-        kb = k_ref[0, pl.ds(off, bk), :].astype(jnp.float32)
-        vb = v_ref[0, pl.ds(off, bk), :].astype(jnp.float32)
+    @pl.when(jk == 0)
+    def _init():
+        m_sc[...] = jnp.full_like(m_sc, _NEG)
+        l_sc[...] = jnp.zeros_like(l_sc)
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+
+    # causal: blocks entirely above the diagonal contribute nothing —
+    # skip their FLOPs (the grid still steps through them)
+    live = (jk * bk <= (iq + 1) * bq - 1) if causal else True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale      # (BQ, D)
+        kb = k_ref[0].astype(jnp.float32)             # (BK, D)
+        vb = v_ref[0].astype(jnp.float32)
         s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32)
         if causal:
-            q_pos = iq * bq + lax.broadcasted_iota(
-                jnp.int32, (bq, bk), 0)
-            k_pos = j * bk + lax.broadcasted_iota(
-                jnp.int32, (bq, bk), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG)
+            s = _causal_mask(s, iq, jk, bq, bk)
+        m = m_sc[...]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
-        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * alpha + jnp.dot(
+        m_sc[...] = m_new
+        l_sc[...] = l_sc[...] * alpha + jnp.sum(p, axis=-1,
+                                                keepdims=True)
+        acc_sc[...] = acc_sc[...] * alpha + jnp.dot(
             p, vb, preferred_element_type=jnp.float32)
-        return m_new, l, acc
 
-    if causal:
-        # key tiles entirely above the diagonal contribute nothing:
-        # bound the loop at the last tile any of this query tile's
-        # rows can see (~halves the causal FLOPs)
-        upper = jnp.minimum(nk, ((iq + 1) * bq + bk - 1) // bk)
-    else:
-        upper = nk
-    m, l, acc = lax.fori_loop(0, upper, body, (m, l, acc))
-    o_ref[0] = (acc / l).astype(o_ref.dtype)
-    # log-sum-exp residual: what the backward needs to rebuild P
-    # tile-by-tile without the L x L score matrix
-    lse_ref[0] = (m[:, 0] + jnp.log(l[:, 0]))
+    @pl.when(jk == nk - 1)
+    def _finalize():
+        l = l_sc[...]
+        o_ref[0] = (acc_sc[...] / l).astype(o_ref.dtype)
+        # log-sum-exp residual: what the backward needs to rebuild P
+        # tile-by-tile without the L x L score matrix
+        lse_ref[0] = m_sc[...][:, 0] + jnp.log(l[:, 0])
 
 
 def _flash_fwd(q, k, v, causal, scale, interpret):
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     bh, lq, d = q.shape
     lk = k.shape[1]
     bq = min(128, lq)
     bk = min(128, lk)
-    kernel = functools.partial(_fwd_kernel, bq=bq, bk=bk,
-                               nk=lk // bk, causal=causal,
-                               scale=scale)
+    nk = lk // bk
+    kernel = functools.partial(_fwd_kernel, bq=bq, bk=bk, nk=nk,
+                               causal=causal, scale=scale)
     o, lse = pl.pallas_call(
         kernel,
-        grid=(bh, lq // bq),
+        grid=(bh, lq // bq, nk),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, lk, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, lk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
             jax.ShapeDtypeStruct((bh, lq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v)
@@ -122,80 +144,86 @@ def _flash_fwd(q, k, v, causal, scale, interpret):
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
-               dq_ref, *, bq, bk, nk, causal, scale):
+               dq_ref, dq_sc, *, bq, bk, nk, causal, scale):
+    """grid = (BH, NQ, NK): k/v stream past a resident q tile; dq
+    accumulates in scratch."""
     from jax.experimental import pallas as pl
 
     iq = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)                  # (BQ, D)
-    g = g_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, None]                         # (BQ, 1)
-    delta = delta_ref[0][:, None]
-    dq = jnp.zeros_like(q)
+    jk = pl.program_id(2)
 
-    def body(j, dq):
-        off = pl.multiple_of(j * bk, bk)
-        kb = k_ref[0, pl.ds(off, bk), :].astype(jnp.float32)
-        vb = v_ref[0, pl.ds(off, bk), :].astype(jnp.float32)
+    @pl.when(jk == 0)
+    def _init():
+        dq_sc[...] = jnp.zeros_like(dq_sc)
+
+    live = (jk * bk <= (iq + 1) * bq - 1) if causal else True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)              # (BQ, D)
+        g = g_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, None]
+        delta = delta_ref[0][:, None]
+        kb = k_ref[0].astype(jnp.float32)
+        vb = v_ref[0].astype(jnp.float32)
         s = jnp.dot(q, kb.T,
                     preferred_element_type=jnp.float32) * scale
         if causal:
-            q_pos = iq * bq + lax.broadcasted_iota(
-                jnp.int32, (bq, bk), 0)
-            k_pos = j * bk + lax.broadcasted_iota(
-                jnp.int32, (bq, bk), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG)
+            s = _causal_mask(s, iq, jk, bq, bk)
         p = jnp.exp(s - lse)
         dp = jnp.dot(g, vb.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
-        return dq + jnp.dot(ds, kb,
-                            preferred_element_type=jnp.float32)
+        dq_sc[...] = dq_sc[...] + jnp.dot(
+            ds, kb, preferred_element_type=jnp.float32)
 
-    upper = jnp.minimum(nk, ((iq + 1) * bq + bk - 1) // bk) \
-        if causal else nk
-    dq = lax.fori_loop(0, upper, body, dq)
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+    @pl.when(jk == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_sc[...].astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, *, bq, bk, nq, causal, scale):
+                dk_ref, dv_ref, dk_sc, dv_sc, *, bq, bk, nq, causal,
+                scale):
+    """grid = (BH, NK, NQ): q/g/lse/delta stream past a resident k/v
+    tile; dk/dv accumulate in scratch."""
     from jax.experimental import pallas as pl
 
     jk = pl.program_id(1)
-    kb = k_ref[0].astype(jnp.float32)                 # (BK, D)
-    vb = v_ref[0].astype(jnp.float32)
-    dk = jnp.zeros_like(kb)
-    dv = jnp.zeros_like(vb)
+    iq = pl.program_id(2)
 
-    def body(i, carry):
-        dk, dv = carry
-        off = pl.multiple_of(i * bq, bq)
-        qb = q_ref[0, pl.ds(off, bq), :].astype(jnp.float32)
-        gb = g_ref[0, pl.ds(off, bq), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(off, bq)][:, None]
-        delta = delta_ref[0, pl.ds(off, bq)][:, None]
+    @pl.when(iq == 0)
+    def _init():
+        dk_sc[...] = jnp.zeros_like(dk_sc)
+        dv_sc[...] = jnp.zeros_like(dv_sc)
+
+    # causal: q tiles strictly above this k tile's diagonal see none
+    # of it
+    live = ((iq + 1) * bq - 1 >= jk * bk) if causal else True
+
+    @pl.when(live)
+    def _step():
+        kb = k_ref[0].astype(jnp.float32)             # (BK, D)
+        vb = v_ref[0].astype(jnp.float32)
+        qb = q_ref[0].astype(jnp.float32)             # (BQ, D)
+        gb = g_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, None]
+        delta = delta_ref[0][:, None]
         s = jnp.dot(qb, kb.T,
                     preferred_element_type=jnp.float32) * scale
         if causal:
-            q_pos = i * bq + lax.broadcasted_iota(
-                jnp.int32, (bq, bk), 0)
-            k_pos = jk * bk + lax.broadcasted_iota(
-                jnp.int32, (bq, bk), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG)
+            s = _causal_mask(s, iq, jk, bq, bk)
         p = jnp.exp(s - lse)
-        dv = dv + jnp.dot(p.T, gb,
-                          preferred_element_type=jnp.float32)
+        dv_sc[...] = dv_sc[...] + jnp.dot(
+            p.T, gb, preferred_element_type=jnp.float32)
         dp = jnp.dot(gb, vb.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
-        dk = dk + jnp.dot(ds.T, qb,
-                          preferred_element_type=jnp.float32)
-        return dk, dv
+        dk_sc[...] = dk_sc[...] + jnp.dot(
+            ds.T, qb, preferred_element_type=jnp.float32)
 
-    # causal: q tiles strictly above this k tile's diagonal see none
-    # of it — start at the first tile that can attend here
-    lower = (jk * bk) // bq if causal else 0
-    dk, dv = lax.fori_loop(lower, nq, body, (dk, dv))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(iq == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_sc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_sc[...].astype(dv_ref.dtype)
 
 
 def _flash_bwd(q, k, v, o, lse, g, causal, scale, interpret):
@@ -203,6 +231,7 @@ def _flash_bwd(q, k, v, o, lse, g, causal, scale, interpret):
     L x L tensor in HBM on the gradient path either (the FlashAttention
     backward recipe: delta = rowsum(g * o), dS = P*(dP - delta))."""
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     bh, lq, d = q.shape
     lk = k.shape[1]
@@ -213,38 +242,44 @@ def _flash_bwd(q, k, v, o, lse, g, causal, scale, interpret):
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, bq=bq, bk=bk, nk=lk // bk,
                           causal=causal, scale=scale),
-        grid=(bh, lq // bq),
+        grid=(bh, lq // bq, lk // bk),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, lk, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, lk, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
-            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+        out_specs=pl.BlockSpec((1, bq, d),
+                               lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
     )(q, k, v, g, lse, delta)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, bq=bq, bk=bk, nq=lq // bq,
                           causal=causal, scale=scale),
-        grid=(bh, lk // bk),
+        grid=(bh, lk // bk, lq // bq),
         in_specs=[
-            pl.BlockSpec((1, lq, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, lq, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, lq), lambda b, j: (b, 0)),
-            pl.BlockSpec((1, lq), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, bq), lambda b, j, i: (b, i)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(k.shape, k.dtype),
             jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v, g, lse, delta)
@@ -252,21 +287,13 @@ def _flash_bwd(q, k, v, o, lse, g, causal, scale, interpret):
 
 
 def _supported(q, k):
+    """The tiling needs 128-divisible (or single-tile) sequence
+    lengths.  VMEM use is O(block) — sequence length is NOT a
+    constraint (the r5 streaming kernels; the r4 whole-sequence
+    staging hit the VMEM wall near L*D ~ 2^20)."""
     lq, lk = q.shape[1], k.shape[1]
-    if not (q.ndim == 3 and lq % min(128, lq) == 0
-            and lk % min(128, lk) == 0):
-        return False
-    # VMEM ceiling: the kernels stage whole-sequence operands per grid
-    # step (fwd/dq: full k+v; dkv: full q+g), i.e. ~2*L*D fp32 plus
-    # block-sized buffers.  VMEM is ~16 MB/core; past L*D ~ 2^20
-    # (8 MB staged) the backward stops fitting and Mosaic fails to
-    # compile or spills (advisor r4).  Longer sequences fall back to
-    # the XLA reference — ring attention (parallel/ring_attention.py)
-    # is the intended long-context path.
-    max_elems = int(os.environ.get("MXTPU_FLASH_MAX_STAGED_ELEMS",
-                                   2 ** 20))
-    d = q.shape[-1]
-    return max(lq, lk) * d <= max_elems
+    return (q.ndim == 3 and lq % min(128, lq) == 0
+            and lk % min(128, lk) == 0)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))

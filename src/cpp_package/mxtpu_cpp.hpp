@@ -73,7 +73,19 @@ class NDArray {
   }
 
   bool empty() const { return !h_; }
-  NDArrayHandle handle() const { return h_ ? h_->h : nullptr; }
+
+  /* throws instead of handing the C API a null it would deref: a
+   * default-constructed NDArray used as `kv.Pull("w", &w)` output or
+   * `w.Shape()` is a user error that must surface as an exception,
+   * not a segfault */
+  NDArrayHandle handle() const {
+    if (!h_) {
+      throw std::runtime_error(
+          "empty NDArray: construct it with a shape/context before "
+          "use");
+    }
+    return h_->h;
+  }
 
   std::vector<mx_uint> Shape() const {
     mx_uint ndim = 0;

@@ -55,8 +55,11 @@ def test_launch_elastic_restart(tmp_path):
         "and not os.path.exists(m):\n"
         "    open(m, 'w').close()\n"
         "    sys.exit(9)\n"
-        "print('ATTEMPT', os.environ['MXTPU_RESTART_ATTEMPT'],"
-        " 'rank', os.environ['MXTPU_WORKER_RANK'])\n")
+        # one os.write syscall: atomic for <PIPE_BUF, so concurrent
+        # workers sharing the pipe can't interleave mid-line
+        "os.write(1, ('ATTEMPT %s rank %s\\n' % ("
+        "os.environ['MXTPU_RESTART_ATTEMPT'],"
+        " os.environ['MXTPU_WORKER_RANK'])).encode())\n")
     r = subprocess.run(
         [sys.executable, os.path.join(repo, "tools", "launch.py"),
          "-n", "2", "--max-restarts", "2", "--",

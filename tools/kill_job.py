@@ -36,13 +36,22 @@ def _kill_cmd(pattern, sig):
     # pgrep -f matches an ERE; escape so the CLI keeps its documented
     # substring semantics ('train[0].py' means those literal chars)
     pat = shlex.quote(re.escape(pattern))
+    # - empty cmdline (unreadable /proc, pid raced away) SKIPS — the
+    #   fail-open alternative can kill the ssh shell carrying this
+    #   very loop
+    # - pgrep finding nothing is success (nothing to clean); pgrep
+    #   MISSING or a shell error is a real failure and propagates
+    #   through the ssh exit code
+    # - the kill count is reported so callers can tell "clean host"
+    #   from "killed 3"
     return (
+        "command -v pgrep >/dev/null || exit 127; n=0; "
         f"for p in $(pgrep -u {user} -f {pat}); do "
         "c=$(tr '\\0' ' ' < /proc/$p/cmdline 2>/dev/null); "
         'case "$c" in '
-        "*kill_job*|*pgrep*|*pkill*) ;; "
-        f"*) kill -{sig} $p 2>/dev/null ;; "
-        "esac; done; true")
+        '""|*kill_job*|*pgrep*|*pkill*) ;; '
+        f"*) kill -{sig} $p 2>/dev/null && n=$((n+1)) ;; "
+        "esac; done; echo MXTPU_KILLED:$n")
 
 
 def main():
@@ -65,10 +74,20 @@ def main():
                  "use the training script's name")
 
     cmd = _kill_cmd(args.pattern, args.signal)
+
+    def describe(rc, out, err):
+        if rc != 0:
+            return f"rc={rc}: {err.strip()[-200:]}", True
+        m = re.search(r"MXTPU_KILLED:(\d+)", out)
+        n = m.group(1) if m else "?"
+        return f"ok (killed {n})", False
+
     if not args.hostfile:
-        rc = subprocess.call(["sh", "-c", cmd])
-        print(f"localhost: {'ok' if rc == 0 else f'rc={rc}'}")
-        return 0
+        r = subprocess.run(["sh", "-c", cmd], capture_output=True,
+                           text=True, timeout=60)
+        status, failed = describe(r.returncode, r.stdout, r.stderr)
+        print(f"localhost: {status}")
+        return 1 if failed else 0
 
     hosts = [h for h, _ in _parse_hostfile(args.hostfile)]
     failures = 0
@@ -81,13 +100,11 @@ def main():
             r = subprocess.run(base + [host, cmd],
                                capture_output=True, text=True,
                                timeout=60)
-            status = "ok" if r.returncode == 0 else \
-                f"rc={r.returncode}: {r.stderr.strip()[-200:]}"
-            failed = r.returncode != 0
+            status, failed = describe(r.returncode, r.stdout,
+                                      r.stderr)
         except subprocess.TimeoutExpired:
             # a dead host must not stop cleanup of the others
-            status = "timeout (host unreachable?)"
-            failed = True
+            status, failed = "timeout (host unreachable?)", True
         print(f"{host}: {status}")
         failures += failed
     return 1 if failures else 0
