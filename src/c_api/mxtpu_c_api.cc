@@ -124,6 +124,31 @@ def invoke(op_name, inputs, keys, vals):
     return list(out) if isinstance(out, (list, tuple)) else [out]
 
 
+def nd_slice(arr, start, stop):
+    return arr[int(start):int(stop)]
+
+
+def nd_reshape(arr, dims):
+    return arr.reshape(tuple(int(d) for d in dims))
+
+
+def nd_save(fname, arrays, keys):
+    from incubator_mxnet_tpu import nd
+    if keys:
+        nd.save(fname, dict(zip(keys, arrays)))
+    else:
+        nd.save(fname, list(arrays))
+
+
+def nd_load(fname):
+    from incubator_mxnet_tpu import nd
+    data = nd.load(fname)
+    if isinstance(data, dict):
+        names = list(data)
+        return [data[n] for n in names], names
+    return list(data), ["" for _ in data]
+
+
 def kv_create(kv_type):
     import incubator_mxnet_tpu as mx
     return mx.kv.create(kv_type)
@@ -420,6 +445,97 @@ int MXNDArrayFree(NDArrayHandle handle) {
     Py_XDECREF(h->obj);
   }
   delete h;
+  return 0;
+}
+
+int MXNDArraySlice(NDArrayHandle handle, mx_uint start,
+                   mx_uint stop, NDArrayHandle *out) {
+  auto *h = static_cast<NDHandle *>(handle);
+  GIL gil;
+  PyObject *obj = glue_call("nd_slice", "(OII)", h->obj, start,
+                            stop);
+  if (obj == nullptr) return -1;
+  auto *nh = new NDHandle();
+  nh->obj = obj;
+  *out = nh;
+  return 0;
+}
+
+int MXNDArrayReshape(NDArrayHandle handle, int ndim,
+                     const int *dims, NDArrayHandle *out) {
+  auto *h = static_cast<NDHandle *>(handle);
+  GIL gil;
+  PyObject *t = PyTuple_New(ndim);
+  if (t == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  for (int i = 0; i < ndim; ++i) {
+    PyTuple_SET_ITEM(t, i, PyLong_FromLong(dims[i]));
+  }
+  PyObject *obj = glue_call("nd_reshape", "(OO)", h->obj, t);
+  Py_DECREF(t);
+  if (obj == nullptr) return -1;
+  auto *nh = new NDHandle();
+  nh->obj = obj;
+  *out = nh;
+  return 0;
+}
+
+int MXNDArraySave(const char *fname, mx_uint num,
+                  NDArrayHandle *handles, const char **keys) {
+  if (ensure_runtime() != 0) return -1;
+  GIL gil;
+  PyObject *arrs = handle_list(num, handles);
+  PyObject *ks = keys != nullptr ? str_list(num, keys) : Py_None;
+  if (keys == nullptr) Py_INCREF(Py_None);
+  PyObject *r = (arrs && ks)
+                    ? glue_call("nd_save", "(sOO)", fname, arrs, ks)
+                    : nullptr;
+  if (r == nullptr && PyErr_Occurred()) set_error_from_python();
+  Py_XDECREF(arrs);
+  Py_XDECREF(ks);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayLoad(const char *fname, mx_uint *num,
+                  NDArrayHandle *out_arrays,
+                  const char ***out_names) {
+  if (ensure_runtime() != 0) return -1;
+  GIL gil;
+  PyObject *r = glue_call("nd_load", "(s)", fname);
+  if (r == nullptr) return -1;
+  PyObject *arrs = PyTuple_GET_ITEM(r, 0);
+  PyObject *names = PyTuple_GET_ITEM(r, 1);
+  Py_ssize_t n = PyList_Size(arrs);
+  if (n > static_cast<Py_ssize_t>(*num)) {
+    g_last_error = "file holds " + std::to_string(n) +
+                   " arrays, caller buffer holds " +
+                   std::to_string(*num);
+    Py_DECREF(r);
+    return -1;
+  }
+  /* thread-lifetime name storage, same contract as the header */
+  static thread_local std::vector<std::string> name_store;
+  static thread_local std::vector<const char *> name_ptrs;
+  name_store.clear();
+  name_ptrs.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    name_store.emplace_back(
+        PyUnicode_AsUTF8(PyList_GET_ITEM(names, i)));
+  }
+  for (const auto &s : name_store) name_ptrs.push_back(s.c_str());
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    auto *nh = new NDHandle();
+    nh->obj = PyList_GET_ITEM(arrs, i);
+    Py_INCREF(nh->obj);
+    out_arrays[i] = nh;
+  }
+  Py_DECREF(r);
+  *num = static_cast<mx_uint>(n);
+  *out_names = name_ptrs.data();
   return 0;
 }
 

@@ -73,6 +73,26 @@ int MXNDArrayWaitAll(void);
 
 int MXNDArrayFree(NDArrayHandle handle);
 
+/* Views/copies (ref: MXNDArraySlice / MXNDArrayReshape): slice is
+ * [start, stop) along axis 0; reshape accepts one -1 wildcard. */
+int MXNDArraySlice(NDArrayHandle handle, mx_uint start,
+                   mx_uint stop, NDArrayHandle *out);
+int MXNDArrayReshape(NDArrayHandle handle, int ndim,
+                     const int *dims, NDArrayHandle *out);
+
+/* Save/load in the framework's tagged .params format — the SAME
+ * files Python's nd.save/nd.load and the predict/train ABIs use, so
+ * C and Python clients interoperate on artifacts
+ * (ref: MXNDArraySave / MXNDArrayLoad).
+ * Load: out_names[i] pointers are owned by the library and valid
+ * until the next MXNDArrayLoad on this thread; arrays are new
+ * handles the caller frees.  `num` is in: capacity / out: count. */
+int MXNDArraySave(const char *fname, mx_uint num,
+                  NDArrayHandle *handles, const char **keys);
+int MXNDArrayLoad(const char *fname, mx_uint *num,
+                  NDArrayHandle *out_arrays,
+                  const char ***out_names);
+
 /* -------------------------------------------------- operator invoke */
 
 /* Names of every registered operator; pointers are owned by the

@@ -335,3 +335,80 @@ def test_invoke_rejects_non_registry_attributes():
                                       None, None) == -1, name
         assert b"unknown operator" in lib.MXTPUCApiGetLastError()
     lib.MXNDArrayFree(x)
+
+
+def test_slice_reshape_save_load(tmp_path):
+    """Slice/reshape views and the tagged .params save/load round
+    trip — the SAME file format Python's nd.save/nd.load uses, so C
+    and Python clients interoperate on artifacts."""
+    lib = _bind(ctypes.CDLL(_build_lib()))
+    lib.MXNDArraySlice.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint, ctypes.c_uint,
+        ctypes.POINTER(ctypes.c_void_p)]
+    lib.MXNDArrayReshape.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_void_p)]
+    lib.MXNDArraySave.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint,
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_char_p)]
+    lib.MXNDArrayLoad.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint),
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_char_p))]
+
+    x = np.arange(24, dtype=np.float32).reshape(6, 4)
+    h = _nd_from_np(lib, x)
+
+    s = ctypes.c_void_p()
+    assert lib.MXNDArraySlice(h, 1, 4, ctypes.byref(s)) == 0, \
+        lib.MXTPUCApiGetLastError()
+    np.testing.assert_array_equal(_np_from_nd(lib, s), x[1:4])
+
+    r = ctypes.c_void_p()
+    dims = (ctypes.c_int * 2)(8, -1)
+    assert lib.MXNDArrayReshape(h, 2, dims, ctypes.byref(r)) == 0, \
+        lib.MXTPUCApiGetLastError()
+    np.testing.assert_array_equal(_np_from_nd(lib, r),
+                                  x.reshape(8, 3))
+
+    # save from C, load from C
+    fname = str(tmp_path / "params.params").encode()
+    keys = (ctypes.c_char_p * 2)(b"arg:weight", b"aux:mean")
+    handles = (ctypes.c_void_p * 2)(h, s)
+    assert lib.MXNDArraySave(fname, 2, handles, keys) == 0, \
+        lib.MXTPUCApiGetLastError()
+    n = ctypes.c_uint(8)
+    loaded = (ctypes.c_void_p * 8)()
+    names = ctypes.POINTER(ctypes.c_char_p)()
+    assert lib.MXNDArrayLoad(fname, ctypes.byref(n), loaded,
+                             ctypes.byref(names)) == 0, \
+        lib.MXTPUCApiGetLastError()
+    assert n.value == 2
+    got = {names[i].decode(): _np_from_nd(lib, loaded[i])
+           for i in range(2)}
+    np.testing.assert_array_equal(got["arg:weight"], x)
+    np.testing.assert_array_equal(got["aux:mean"], x[1:4])
+
+    # and load the C-written file from PYTHON (interop proof)
+    import os as _os
+    import subprocess as _sp
+    import sys as _sys
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    code = (
+        "import os\n"
+        "os.environ['XLA_FLAGS']='--xla_force_host_platform_device_count=1'\n"
+        "import jax; jax.config.update('jax_platforms','cpu')\n"
+        "import numpy as np\n"
+        "from incubator_mxnet_tpu import nd\n"
+        f"d = nd.load({fname.decode()!r})\n"
+        "assert sorted(d) == ['arg:weight', 'aux:mean'], d\n"
+        "assert d['arg:weight'].shape == (6, 4)\n"
+        "print('PY_LOAD_OK')\n")
+    rr = _sp.run([_sys.executable, "-c", code], capture_output=True,
+                 text=True, timeout=300, env=env)
+    assert rr.returncode == 0, rr.stderr[-1000:]
+    assert "PY_LOAD_OK" in rr.stdout
+    for hh in (h, s, r, loaded[0], loaded[1]):
+        lib.MXNDArrayFree(hh)
