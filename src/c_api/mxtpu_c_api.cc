@@ -125,7 +125,15 @@ def invoke(op_name, inputs, keys, vals):
 
 
 def nd_slice(arr, start, stop):
-    return arr[int(start):int(stop)]
+    start, stop = int(start), int(stop)
+    n = int(arr.shape[0])
+    # explicit bounds: C callers must get an error, not Python's
+    # silent clamping (the reference C API rejects bad slices too)
+    if not (0 <= start < stop <= n):
+        raise ValueError(
+            "slice [%d, %d) out of range for axis-0 length %d"
+            % (start, stop, n))
+    return arr[start:stop]
 
 
 def nd_reshape(arr, dims):
@@ -135,6 +143,10 @@ def nd_reshape(arr, dims):
 def nd_save(fname, arrays, keys):
     from incubator_mxnet_tpu import nd
     if keys:
+        if len(set(keys)) != len(keys):
+            dupes = sorted({k for k in keys if keys.count(k) > 1})
+            raise ValueError("duplicate keys %r would silently drop "
+                             "arrays" % (dupes,))
         nd.save(fname, dict(zip(keys, arrays)))
     else:
         nd.save(fname, list(arrays))
@@ -514,6 +526,9 @@ int MXNDArrayLoad(const char *fname, mx_uint *num,
     g_last_error = "file holds " + std::to_string(n) +
                    " arrays, caller buffer holds " +
                    std::to_string(*num);
+    /* report the required capacity so callers can size-and-retry
+     * (pass *num = 0 to just query the count) */
+    *num = static_cast<mx_uint>(n);
     Py_DECREF(r);
     return -1;
   }

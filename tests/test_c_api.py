@@ -391,8 +391,6 @@ def test_slice_reshape_save_load(tmp_path):
     np.testing.assert_array_equal(got["aux:mean"], x[1:4])
 
     # and load the C-written file from PYTHON (interop proof)
-    import os as _os
-    import subprocess as _sp
     import sys as _sys
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
@@ -406,9 +404,50 @@ def test_slice_reshape_save_load(tmp_path):
         "assert sorted(d) == ['arg:weight', 'aux:mean'], d\n"
         "assert d['arg:weight'].shape == (6, 4)\n"
         "print('PY_LOAD_OK')\n")
-    rr = _sp.run([_sys.executable, "-c", code], capture_output=True,
-                 text=True, timeout=300, env=env)
+    rr = subprocess.run([_sys.executable, "-c", code],
+                        capture_output=True, text=True, timeout=300,
+                        env=env)
     assert rr.returncode == 0, rr.stderr[-1000:]
     assert "PY_LOAD_OK" in rr.stdout
     for hh in (h, s, r, loaded[0], loaded[1]):
         lib.MXNDArrayFree(hh)
+
+
+def test_slice_save_error_contracts(tmp_path):
+    """Out-of-range slices error (no silent clamp), duplicate save
+    keys error (no silent drop), and a too-small Load buffer reports
+    the required capacity through *num."""
+    lib = _bind(ctypes.CDLL(_build_lib()))
+    lib.MXNDArraySlice.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint, ctypes.c_uint,
+        ctypes.POINTER(ctypes.c_void_p)]
+    lib.MXNDArraySave.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint,
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_char_p)]
+    lib.MXNDArrayLoad.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint),
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_char_p))]
+
+    h = _nd_from_np(lib, np.zeros((6, 2), np.float32))
+    s = ctypes.c_void_p()
+    assert lib.MXNDArraySlice(h, 4, 100, ctypes.byref(s)) == -1
+    assert b"out of range" in lib.MXTPUCApiGetLastError()
+    assert lib.MXNDArraySlice(h, 3, 3, ctypes.byref(s)) == -1
+
+    fname = str(tmp_path / "dup.params").encode()
+    keys = (ctypes.c_char_p * 2)(b"w", b"w")
+    handles = (ctypes.c_void_p * 2)(h, h)
+    assert lib.MXNDArraySave(fname, 2, handles, keys) == -1
+    assert b"duplicate" in lib.MXTPUCApiGetLastError()
+
+    ok_keys = (ctypes.c_char_p * 2)(b"a", b"b")
+    assert lib.MXNDArraySave(fname, 2, handles, ok_keys) == 0
+    n = ctypes.c_uint(0)          # query mode: too-small on purpose
+    loaded = (ctypes.c_void_p * 1)()
+    names = ctypes.POINTER(ctypes.c_char_p)()
+    assert lib.MXNDArrayLoad(fname, ctypes.byref(n), loaded,
+                             ctypes.byref(names)) == -1
+    assert n.value == 2           # required capacity reported
+    lib.MXNDArrayFree(h)
