@@ -161,6 +161,56 @@ def nd_load(fname):
     return list(data), ["" for _ in data]
 
 
+def sym_variable(name):
+    import incubator_mxnet_tpu as mx
+    return mx.sym.Variable(name)
+
+
+def sym_from_operator(op_name, inputs, name, keys, vals):
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.ops.registry import OPS
+    if op_name not in OPS:
+        raise ValueError("unknown operator %r" % (op_name,))
+    fn = getattr(mx.sym, op_name, None) or \
+        getattr(mx.sym._internal, op_name, None)
+    if fn is None:
+        raise ValueError(
+            "operator %r has no sym frontend" % (op_name,))
+    kwargs = {}
+    for k, v in zip(keys, vals):
+        try:
+            kwargs[k] = ast.literal_eval(v)
+        except (ValueError, SyntaxError):
+            kwargs[k] = v
+    if name:
+        kwargs["name"] = name
+    return fn(*inputs, **kwargs)
+
+
+def sym_from_json(js):
+    import incubator_mxnet_tpu as mx
+    return mx.sym.load_json(js)
+
+
+def sym_tojson(sym):
+    return sym.tojson()
+
+
+def sym_list_arguments(sym):
+    return list(sym.list_arguments())
+
+
+def sym_list_outputs(sym):
+    return list(sym.list_outputs())
+
+
+def sym_infer_out_shapes(sym, shapes):
+    _, out_shapes, _ = sym.infer_shape(**{
+        k: tuple(int(d) for d in v) for k, v in shapes.items()})
+    return [tuple(int(d) for d in s) if s is not None else None
+            for s in out_shapes]
+
+
 def kv_create(kv_type):
     import incubator_mxnet_tpu as mx
     return mx.kv.create(kv_type)
@@ -193,6 +243,25 @@ struct NDHandle {
 
 struct KVHandle {
   PyObject *obj;                 /* framework KVStore */
+};
+
+struct SymHandle {
+  PyObject *obj;                 /* framework Symbol */
+};
+
+/* thread-lifetime string-list storage for listing calls */
+struct StrListStore {
+  std::vector<std::string> strs;
+  std::vector<const char *> ptrs;
+  const char **fill(PyObject *list) {   /* list of str; GIL held */
+    strs.clear();
+    ptrs.clear();
+    for (Py_ssize_t i = 0; i < PyList_Size(list); ++i) {
+      strs.emplace_back(PyUnicode_AsUTF8(PyList_GET_ITEM(list, i)));
+    }
+    for (const auto &s : strs) ptrs.push_back(s.c_str());
+    return ptrs.data();
+  }
 };
 
 class GIL {
@@ -611,6 +680,165 @@ int MXImperativeInvoke(const char *op_name, int num_inputs,
   }
   Py_DECREF(r);
   *num_outputs = static_cast<int>(n);
+  return 0;
+}
+
+int MXSymbolCreateVariable(const char *name, SymbolHandle *out) {
+  if (ensure_runtime() != 0) return -1;
+  GIL gil;
+  PyObject *obj = glue_call("sym_variable", "(s)", name);
+  if (obj == nullptr) return -1;
+  auto *h = new SymHandle();
+  h->obj = obj;
+  *out = h;
+  return 0;
+}
+
+int MXSymbolCreateFromOperator(const char *op_name, int num_inputs,
+                               SymbolHandle *inputs,
+                               const char *name, int num_params,
+                               const char **param_keys,
+                               const char **param_vals,
+                               SymbolHandle *out) {
+  if (ensure_runtime() != 0) return -1;
+  GIL gil;
+  PyObject *ins = PyList_New(num_inputs);
+  if (ins == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  for (int i = 0; i < num_inputs; ++i) {
+    PyObject *o = static_cast<SymHandle *>(inputs[i])->obj;
+    Py_INCREF(o);
+    PyList_SET_ITEM(ins, i, o);
+  }
+  PyObject *keys = str_list(num_params, param_keys);
+  PyObject *vals = str_list(num_params, param_vals);
+  PyObject *r = (keys && vals)
+                    ? glue_call("sym_from_operator", "(sOsOO)",
+                                op_name, ins,
+                                name != nullptr ? name : "", keys,
+                                vals)
+                    : nullptr;
+  if (r == nullptr && PyErr_Occurred()) set_error_from_python();
+  Py_DECREF(ins);
+  Py_XDECREF(keys);
+  Py_XDECREF(vals);
+  if (r == nullptr) return -1;
+  auto *h = new SymHandle();
+  h->obj = r;
+  *out = h;
+  return 0;
+}
+
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out) {
+  if (ensure_runtime() != 0) return -1;
+  GIL gil;
+  PyObject *obj = glue_call("sym_from_json", "(s)", json);
+  if (obj == nullptr) return -1;
+  auto *h = new SymHandle();
+  h->obj = obj;
+  *out = h;
+  return 0;
+}
+
+int MXSymbolToJSON(SymbolHandle handle, const char **out_json) {
+  auto *h = static_cast<SymHandle *>(handle);
+  GIL gil;
+  PyObject *r = glue_call("sym_tojson", "(O)", h->obj);
+  if (r == nullptr) return -1;
+  static thread_local std::string json_store;
+  json_store = PyUnicode_AsUTF8(r);
+  Py_DECREF(r);
+  *out_json = json_store.c_str();
+  return 0;
+}
+
+static int sym_list(const char *fn, SymbolHandle handle,
+                    mx_uint *out_size, const char ***out_array) {
+  auto *h = static_cast<SymHandle *>(handle);
+  GIL gil;
+  PyObject *r = glue_call(fn, "(O)", h->obj);
+  if (r == nullptr) return -1;
+  static thread_local StrListStore store;
+  *out_array = store.fill(r);
+  *out_size = static_cast<mx_uint>(store.ptrs.size());
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXSymbolListArguments(SymbolHandle handle, mx_uint *out_size,
+                          const char ***out_array) {
+  return sym_list("sym_list_arguments", handle, out_size, out_array);
+}
+
+int MXSymbolListOutputs(SymbolHandle handle, mx_uint *out_size,
+                        const char ***out_array) {
+  return sym_list("sym_list_outputs", handle, out_size, out_array);
+}
+
+int MXSymbolInferShape(SymbolHandle handle, mx_uint num_args,
+                       const char **arg_keys,
+                       const mx_uint *arg_shape_indptr,
+                       const mx_uint *arg_shape_data,
+                       mx_uint *out_num, const mx_uint **out_indptr,
+                       const mx_uint **out_shape_data) {
+  auto *h = static_cast<SymHandle *>(handle);
+  GIL gil;
+  PyObject *shapes = PyDict_New();
+  if (shapes == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  for (mx_uint i = 0; i < num_args; ++i) {
+    mx_uint ndim = arg_shape_indptr[i + 1] - arg_shape_indptr[i];
+    PyObject *t = PyTuple_New(ndim);
+    for (mx_uint j = 0; j < ndim; ++j) {
+      PyTuple_SET_ITEM(t, j, PyLong_FromUnsignedLong(
+          arg_shape_data[arg_shape_indptr[i] + j]));
+    }
+    PyDict_SetItemString(shapes, arg_keys[i], t);
+    Py_DECREF(t);
+  }
+  PyObject *r = glue_call("sym_infer_out_shapes", "(OO)", h->obj,
+                          shapes);
+  Py_DECREF(shapes);
+  if (r == nullptr) return -1;
+  static thread_local std::vector<mx_uint> indptr_store;
+  static thread_local std::vector<mx_uint> shape_store;
+  indptr_store.clear();
+  shape_store.clear();
+  indptr_store.push_back(0);
+  Py_ssize_t n = PyList_Size(r);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *t = PyList_GET_ITEM(r, i);
+    if (t == Py_None) {
+      g_last_error = "shape inference failed for output " +
+                     std::to_string(i);
+      Py_DECREF(r);
+      return -1;
+    }
+    for (Py_ssize_t j = 0; j < PyTuple_Size(t); ++j) {
+      shape_store.push_back(static_cast<mx_uint>(
+          PyLong_AsUnsignedLong(PyTuple_GET_ITEM(t, j))));
+    }
+    indptr_store.push_back(
+        static_cast<mx_uint>(shape_store.size()));
+  }
+  Py_DECREF(r);
+  *out_num = static_cast<mx_uint>(n);
+  *out_indptr = indptr_store.data();
+  *out_shape_data = shape_store.data();
+  return 0;
+}
+
+int MXSymbolFree(SymbolHandle handle) {
+  auto *h = static_cast<SymHandle *>(handle);
+  {
+    GIL gil;
+    Py_XDECREF(h->obj);
+  }
+  delete h;
   return 0;
 }
 

@@ -115,6 +115,52 @@ int MXImperativeInvoke(const char *op_name, int num_inputs,
                        const char **param_keys,
                        const char **param_vals);
 
+/* ----------------------------------------------------------- Symbol */
+
+typedef void *SymbolHandle;
+
+/* Graph COMPOSITION from native code (ref: MXSymbolCreateVariable /
+ * MXSymbolCreateAtomicSymbol + MXSymbolCompose, c_api_symbolic.cc).
+ * CreateFromOperator fuses the reference's create-atomic+compose
+ * pair: apply a registered operator to input symbols with string
+ * parameters, yielding a new symbol named `name`.  The JSON a
+ * composed symbol serializes to is the same format Python's
+ * sym.tojson()/load_json and the predict/train ABIs consume, so a C
+ * client can build a model and hand it straight to MXTPUTrainCreate. */
+int MXSymbolCreateVariable(const char *name, SymbolHandle *out);
+int MXSymbolCreateFromOperator(const char *op_name, int num_inputs,
+                               SymbolHandle *inputs,
+                               const char *name, int num_params,
+                               const char **param_keys,
+                               const char **param_vals,
+                               SymbolHandle *out);
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out);
+
+/* Serialized graph; pointer valid until the next ToJSON on this
+ * thread (ref: MXSymbolSaveToJSON). */
+int MXSymbolToJSON(SymbolHandle handle, const char **out_json);
+
+/* Argument/output names; pointers valid until the next listing call
+ * on this thread (ref: MXSymbolListArguments/ListOutputs). */
+int MXSymbolListArguments(SymbolHandle handle, mx_uint *out_size,
+                          const char ***out_array);
+int MXSymbolListOutputs(SymbolHandle handle, mx_uint *out_size,
+                        const char ***out_array);
+
+/* Shape inference from named input shapes (CSR-packed like
+ * MXTPUTrainCreate).  Returns the OUTPUT shapes, CSR-packed into
+ * thread-lifetime storage (ref: MXSymbolInferShape's out_shape
+ * triple; arguments/aux are available from Python — this C surface
+ * reports the outputs, which is what deployment sizing needs). */
+int MXSymbolInferShape(SymbolHandle handle, mx_uint num_args,
+                       const char **arg_keys,
+                       const mx_uint *arg_shape_indptr,
+                       const mx_uint *arg_shape_data,
+                       mx_uint *out_num, const mx_uint **out_indptr,
+                       const mx_uint **out_shape_data);
+
+int MXSymbolFree(SymbolHandle handle);
+
 /* ---------------------------------------------------------- KVStore */
 
 /* type: "local" | "device" | "tpu" (ref: MXKVStoreCreate). */
