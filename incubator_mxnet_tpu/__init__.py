@@ -16,7 +16,8 @@ ICI-mesh collectives.
 from .base import __version__, TShape, MXTPUError
 from . import utils
 from .context import (Context, cpu, tpu, gpu, cpu_pinned, num_tpus,
-                      num_gpus, current_context, default_context)
+                      num_gpus, current_context, default_context,
+                      tpu_memory_info, gpu_memory_info)
 from . import engine
 from . import ops
 from . import ndarray

@@ -64,6 +64,29 @@ class Context:
         import gc
         gc.collect()
 
+    def memory_info(self):
+        """(free_bytes, total_bytes) for this context's device (the
+        reference's ``mx.context.gpu_memory_info`` role,
+        python/mxnet/context.py).
+
+        Reads the PJRT allocator's statistics (HBM on TPU).  Backends
+        that expose no stats (virtual CPU devices) report host memory
+        so capacity planning code keeps working off-device."""
+        import os
+        stats = None
+        try:
+            stats = self.jax_device.memory_stats()
+        except Exception:  # noqa: BLE001 — optional PJRT surface
+            stats = None
+        if stats and stats.get("bytes_limit"):
+            total = int(stats["bytes_limit"])
+            used = int(stats.get("bytes_in_use", 0))
+            return max(total - used, 0), total
+        page = os.sysconf("SC_PAGE_SIZE")
+        total = os.sysconf("SC_PHYS_PAGES") * page
+        avail = os.sysconf("SC_AVPHYS_PAGES") * page
+        return avail, total
+
     # -- with-statement stack --------------------------------------------
     def __enter__(self):
         if not hasattr(Context._default_ctx, "stack"):
@@ -111,6 +134,15 @@ def num_tpus():
 
 
 num_gpus = num_tpus
+
+
+def tpu_memory_info(device_id=0):
+    """(free_bytes, total_bytes) of the accelerator's memory (the
+    reference's ``mx.context.gpu_memory_info``)."""
+    return tpu(device_id).memory_info()
+
+
+gpu_memory_info = tpu_memory_info
 
 
 def default_context():

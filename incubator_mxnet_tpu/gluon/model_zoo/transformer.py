@@ -121,24 +121,86 @@ class CausalSelfAttention(Block):
         return jax.default_backend() == "tpu"
 
 
+class MoEFFN(Block):
+    """Mixture-of-Experts FFN (GShard top-2; see ops/moe.py).
+
+    Expert weights are STACKED over a leading expert dimension —
+    (E, H, D)/(E, D, H) — so the expert compute is one batched MXU
+    contraction and the 'ep' mesh axis shards dimension 0 (the
+    expert-parallel rules in parallel/sharding.py); GSPMD then
+    derives the token all-to-alls.  ``forward`` returns the output
+    AND exposes the load-balance aux loss as ``self.last_aux`` (read
+    it in the same forward pass; add ~1e-2 of it to the loss).
+    """
+
+    def __init__(self, d_model, num_experts, hidden,
+                 capacity_factor=1.25, **kwargs):
+        super().__init__(**kwargs)
+        self._cf = float(capacity_factor)
+        self.num_experts = num_experts
+        with self.name_scope():
+            self.router_weight = self.params.get(
+                "router_weight", shape=(num_experts, d_model))
+            self.expert_up_weight = self.params.get(
+                "expert_up_weight", shape=(num_experts, hidden,
+                                           d_model))
+            self.expert_up_bias = self.params.get(
+                "expert_up_bias", shape=(num_experts, hidden),
+                init="zeros")
+            self.expert_down_weight = self.params.get(
+                "expert_down_weight", shape=(num_experts, d_model,
+                                             hidden))
+            self.expert_down_bias = self.params.get(
+                "expert_down_bias", shape=(num_experts, d_model),
+                init="zeros")
+
+    def forward(self, x):                      # (B, L, D)
+        b, l, d = x.shape
+        y, aux = nd._internal._moe_ffn(
+            x.reshape(b * l, d), self.router_weight.data(),
+            self.expert_up_weight.data(),
+            self.expert_up_bias.data(),
+            self.expert_down_weight.data(),
+            self.expert_down_bias.data(),
+            capacity_factor=self._cf)
+        self.last_aux = aux
+        return y.reshape(b, l, d)
+
+
 class TransformerBlock(Block):
-    """Pre-norm attention + MLP with residuals (GPT-2 layout)."""
+    """Pre-norm attention + MLP with residuals (GPT-2 layout).
+
+    ``moe_experts > 0`` swaps the dense MLP for a top-2-routed
+    Mixture-of-Experts FFN (MoEFFN); the block then exposes the
+    router's load-balance loss as ``self.last_aux``.
+    """
 
     def __init__(self, d_model, n_heads, mlp_ratio=4, dropout=0.0,
-                 seq_parallel=False, **kwargs):
+                 seq_parallel=False, moe_experts=0,
+                 moe_capacity_factor=1.25, **kwargs):
         super().__init__(**kwargs)
+        self.moe_experts = moe_experts
         with self.name_scope():
             self.ln1 = LayerNorm()
             self.attn = CausalSelfAttention(d_model, n_heads,
                                             seq_parallel=seq_parallel)
             self.ln2 = LayerNorm()
-            self.up = Dense(mlp_ratio * d_model, flatten=False,
-                            activation="relu")
-            self.down = Dense(d_model, flatten=False)
+            if moe_experts:
+                self.moe = MoEFFN(d_model, moe_experts,
+                                  mlp_ratio * d_model,
+                                  capacity_factor=moe_capacity_factor)
+            else:
+                self.up = Dense(mlp_ratio * d_model, flatten=False,
+                                activation="relu")
+                self.down = Dense(d_model, flatten=False)
             self.drop = Dropout(dropout)
 
     def forward(self, x):
         x = x + self.drop(self.attn(self.ln1(x)))
+        if self.moe_experts:
+            y = self.moe(self.ln2(x))
+            self.last_aux = self.moe.last_aux
+            return x + self.drop(y)
         return x + self.drop(self.down(self.up(self.ln2(x))))
 
 
@@ -151,16 +213,21 @@ class TransformerLM(Block):
 
     def __init__(self, vocab_size, d_model=512, n_layers=6,
                  n_heads=8, max_len=1024, mlp_ratio=4, dropout=0.0,
-                 seq_parallel=False, **kwargs):
+                 seq_parallel=False, moe_experts=0,
+                 moe_capacity_factor=1.25, **kwargs):
         super().__init__(**kwargs)
         self._d = d_model
         self._max_len = max_len
+        self.moe_experts = moe_experts
         with self.name_scope():
             self.embed = Embedding(vocab_size, d_model)
             self.pos = Embedding(max_len, d_model)
             self.blocks = [
                 TransformerBlock(d_model, n_heads, mlp_ratio, dropout,
-                                 seq_parallel=seq_parallel)
+                                 seq_parallel=seq_parallel,
+                                 moe_experts=moe_experts,
+                                 moe_capacity_factor=
+                                 moe_capacity_factor)
                 for _ in range(n_layers)]
             for i, blk in enumerate(self.blocks):
                 setattr(self, f"block{i}", blk)   # register children
@@ -171,6 +238,9 @@ class TransformerLM(Block):
         self.n_heads = n_heads
 
     def forward(self, tokens):
+        """Logits (B, L, V); with ``moe_experts`` the return is
+        ``[logits, aux]`` where aux is the summed router load-balance
+        loss — add ``~1e-2 * aux`` to the training loss."""
         b, l = tokens.shape
         if l > self._max_len:
             raise ValueError(
@@ -178,9 +248,14 @@ class TransformerLM(Block):
         pos = nd.arange(l).astype("int32")
         x = self.embed(tokens) * math.sqrt(self._d)
         x = nd.broadcast_add(x, self.pos(pos).expand_dims(0))
+        aux = None
         for blk in self.blocks:
             x = blk(x)
-        return self.head(self.ln_f(x))
+            if self.moe_experts:
+                aux = blk.last_aux if aux is None \
+                    else aux + blk.last_aux
+        logits = self.head(self.ln_f(x))
+        return [logits, aux] if self.moe_experts else logits
 
     # ------------------------------------------------------------ decode
     _GEN_CACHE_MAX = 16   # compiled decode executables kept (FIFO)
@@ -260,13 +335,21 @@ class TransformerLM(Block):
 
         layers = []
         for blk in self.blocks:
-            layers.append(dict(
+            lw = dict(
                 ln1=(w(blk.ln1.gamma), w(blk.ln1.beta)),
                 qkv=(w(blk.attn.qkv.weight), w(blk.attn.qkv.bias)),
                 proj=(w(blk.attn.proj.weight), w(blk.attn.proj.bias)),
-                ln2=(w(blk.ln2.gamma), w(blk.ln2.beta)),
-                up=(w(blk.up.weight), w(blk.up.bias)),
-                down=(w(blk.down.weight), w(blk.down.bias))))
+                ln2=(w(blk.ln2.gamma), w(blk.ln2.beta)))
+            if blk.moe_experts:
+                lw["moe"] = (w(blk.moe.router_weight),
+                             w(blk.moe.expert_up_weight),
+                             w(blk.moe.expert_up_bias),
+                             w(blk.moe.expert_down_weight),
+                             w(blk.moe.expert_down_bias))
+            else:
+                lw["up"] = (w(blk.up.weight), w(blk.up.bias))
+                lw["down"] = (w(blk.down.weight), w(blk.down.bias))
+            layers.append(lw)
         return dict(embed=w(self.embed.weight), pos=w(self.pos.weight),
                     ln_f=(w(self.ln_f.gamma), w(self.ln_f.beta)),
                     head=w(self.head.weight), layers=layers)
@@ -286,6 +369,22 @@ class TransformerLM(Block):
             mu = jnp.mean(x, -1, keepdims=True)
             var = jnp.mean((x - mu) ** 2, -1, keepdims=True)
             return (x - mu) / jnp.sqrt(var + 1e-5) * gb[0] + gb[1]
+
+        # capacity factors are STATIC per layer (compile-time), not
+        # part of the traced weights pytree
+        cfs = [blk.moe._cf if blk.moe_experts else None
+               for blk in self.blocks]
+
+        def _ffn(lw, cf, x2d):
+            """Dense or MoE FFN on flattened (T, D) tokens — the
+            SAME routing code as training (ops/moe.py)."""
+            if "moe" in lw:
+                from ...ops.moe import moe_ffn_fn
+                y, _ = moe_ffn_fn(x2d, *lw["moe"],
+                                  capacity_factor=cf)
+                return y
+            return jax.nn.relu(x2d @ lw["up"][0].T + lw["up"][1]) \
+                @ lw["down"][0].T + lw["down"][1]
 
         def restrict(logits):
             """top-k / nucleus filtering on (B, V) logits."""
@@ -322,7 +421,7 @@ class TransformerLM(Block):
                 + wts["pos"][jnp.arange(p)]            # (B, P, D)
             mask = jnp.tril(jnp.ones((p, p), bool))
             caches = []
-            for lw in wts["layers"]:
+            for lw, cf in zip(wts["layers"], cfs):
                 xa = ln(x, lw["ln1"])
                 qkv = xa @ lw["qkv"][0].T + lw["qkv"][1]
                 q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -341,8 +440,8 @@ class TransformerLM(Block):
                 o = o.transpose(0, 2, 1, 3).reshape(b, p, d)
                 x = x + o @ lw["proj"][0].T + lw["proj"][1]
                 xm = ln(x, lw["ln2"])
-                hmid = jax.nn.relu(xm @ lw["up"][0].T + lw["up"][1])
-                x = x + hmid @ lw["down"][0].T + lw["down"][1]
+                x = x + _ffn(lw, cf, xm.reshape(b * p, d)) \
+                    .reshape(b, p, d)
                 caches.append((kc, vc))
             logits = ln(x[:, -1], wts["ln_f"]) @ wts["head"].T
             return caches, logits
@@ -360,7 +459,8 @@ class TransformerLM(Block):
                                                keepdims=False)
                 x = wts["embed"][tok] * scale + wts["pos"][i]
                 new_caches = []
-                for lw, (kc, vc) in zip(wts["layers"], caches):
+                for (lw, cf), (kc, vc) in zip(
+                        zip(wts["layers"], cfs), caches):
                     xa = ln(x, lw["ln1"])
                     qkv = xa @ lw["qkv"][0].T + lw["qkv"][1]
                     q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -378,9 +478,7 @@ class TransformerLM(Block):
                     x = x + o.reshape(b, d) @ lw["proj"][0].T \
                         + lw["proj"][1]
                     xm = ln(x, lw["ln2"])
-                    hmid = jax.nn.relu(
-                        xm @ lw["up"][0].T + lw["up"][1])
-                    x = x + hmid @ lw["down"][0].T + lw["down"][1]
+                    x = x + _ffn(lw, cf, xm)
                     new_caches.append((kc, vc))
                 logits = ln(x, wts["ln_f"]) @ wts["head"].T
                 nxt, rng = pick(logits, temp, rng)

@@ -21,6 +21,7 @@ from . import contrib_det   # noqa: F401
 from . import ctc           # noqa: F401
 from . import contrib_misc  # noqa: F401
 from . import flash         # noqa: F401
+from . import moe           # noqa: F401
 from ..operator import custom as _custom  # noqa: F401  (registers 'Custom')
 
 __all__ = ["OPS", "OpDef", "defop", "alias", "get_op", "find_op",

@@ -274,3 +274,26 @@ def cross_device_copy(data):
     Under jit this is an identity; placement is handled by sharding
     annotations instead of graph-inserted copy nodes."""
     return data + 0
+
+
+@defop("einsum", variadic=True, aliases=["_npi_einsum"])
+def einsum(*operands, subscripts=""):
+    """Einstein summation over any number of operands (the np.einsum
+    surface MXNet 1.6+ exposes as mx.np.einsum; ref:
+    src/operator/numpy/np_einsum_op.cc).  Lowers to jnp.einsum —
+    contractions land on the MXU."""
+    if not subscripts:
+        raise ValueError("einsum needs subscripts=")
+    return jnp.einsum(subscripts, *operands)
+
+
+@defop("cumsum", aliases=["_np_cumsum"])
+def cumsum(data, axis=None, dtype=None):
+    """Cumulative sum (ref: src/operator/numpy/np_cumsum.cc).
+
+    ``dtype`` is the ACCUMULATOR type (numpy semantics): int8 data
+    with dtype='int32' accumulates in int32 — no wraparound before
+    the cast."""
+    from ..base import np_dtype
+    return jnp.cumsum(data, axis=axis,
+                      dtype=np_dtype(dtype) if dtype else None)

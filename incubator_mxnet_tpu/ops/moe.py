@@ -1,0 +1,124 @@
+"""Mixture-of-Experts FFN with top-2 routing (GShard/Switch style).
+
+A capability the reference predates, designed TPU-first the way the
+SURVEY (§5 long-context/parallelism) prescribes for new scale-out
+features: routing is *dense dispatch* — fixed-capacity one-hot
+dispatch/combine tensors contracted with einsums — so every shape is
+static under jit, the expert matmuls are batched over the expert
+dimension (one big MXU contraction, not E small ones), and sharding
+the expert dimension over the mesh's 'ep' axis makes GSPMD insert the
+token all-to-alls automatically (the expert-parallel pattern of
+GShard; see parallel/sharding.py's ep rules).
+
+Registered as the differentiable 2-output op ``_moe_ffn`` so the
+eager tape, hybridized blocks, and ShardedTrainStep all route/
+backprop through identical code: outputs are (tokens_out, aux_loss)
+where aux_loss is the load-balance penalty (E * sum_e f_e * P_e;
+f_e = top-1 dispatch fraction, P_e = mean router probability) the
+training loss should add with a small weight (~1e-2).
+
+Tokens over capacity (C = ceil(cf * 2 * T / E) per expert) are
+DROPPED — their expert contribution is zero and the residual stream
+carries them, the standard GShard overflow semantic that keeps shapes
+static.
+"""
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .registry import defop
+
+__all__ = ["moe_ffn_fn", "top2_gating"]
+
+
+def top2_gating(logits, capacity, renorm=True):
+    """GShard top-2 gating with fixed expert capacity.
+
+    logits : (T, E) router scores (any float dtype; gating runs fp32)
+    returns (combine (T, E, C) f32, dispatch (T, E, C) f32 0/1,
+             aux_loss scalar f32)
+    """
+    t, e = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    idx1 = jnp.argmax(probs, axis=-1)                   # (T,)
+    mask1 = jax.nn.one_hot(idx1, e, dtype=jnp.float32)  # (T, E)
+    p1 = jnp.sum(probs * mask1, axis=-1)
+    probs_wo1 = probs * (1.0 - mask1)
+    idx2 = jnp.argmax(probs_wo1, axis=-1)
+    mask2 = jax.nn.one_hot(idx2, e, dtype=jnp.float32)
+    p2 = jnp.sum(probs * mask2, axis=-1)
+
+    if renorm:
+        denom = p1 + p2 + 1e-9
+        g1, g2 = p1 / denom, p2 / denom
+    else:
+        g1, g2 = p1, p2
+
+    # position of each token in its expert's buffer; second choices
+    # queue behind ALL first choices (GShard's ordering)
+    pos1 = jnp.cumsum(mask1, axis=0) * mask1 - mask1       # (T, E)
+    count1 = jnp.sum(mask1, axis=0, keepdims=True)          # (1, E)
+    pos2 = (jnp.cumsum(mask2, axis=0) - 1.0 + count1) * mask2
+
+    keep1 = mask1 * (pos1 < capacity)
+    keep2 = mask2 * (pos2 < capacity)
+
+    oh1 = jax.nn.one_hot(pos1.astype(jnp.int32), capacity,
+                         dtype=jnp.float32) * keep1[..., None]
+    oh2 = jax.nn.one_hot(pos2.astype(jnp.int32), capacity,
+                         dtype=jnp.float32) * keep2[..., None]
+    dispatch = oh1 + oh2                                    # (T, E, C)
+    combine = g1[:, None, None] * oh1 + g2[:, None, None] * oh2
+
+    # load-balance aux: E * sum_e (top1 dispatch fraction * mean prob)
+    f = jnp.mean(mask1, axis=0)
+    p_mean = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(f * p_mean)
+    return combine, dispatch, aux
+
+
+def moe_ffn_fn(data, router_weight, up_weight, up_bias, down_weight,
+               down_bias, capacity_factor=1.25, renorm=True):
+    """Pure-jnp MoE FFN on flattened tokens.
+
+    data          : (T, D)
+    router_weight : (E, D)   — FullyConnected (out, in) convention
+    up_weight     : (E, H, D);  up_bias (E, H)
+    down_weight   : (E, D, H); down_bias (E, D)
+    returns (out (T, D) in data.dtype, aux_loss scalar f32)
+    """
+    t, d = data.shape
+    e = router_weight.shape[0]
+    capacity = max(1, math.ceil(float(capacity_factor) * 2 * t / e))
+
+    logits = jnp.dot(data.astype(jnp.float32),
+                     router_weight.astype(jnp.float32).T)
+    combine, dispatch, aux = top2_gating(logits, capacity,
+                                         renorm=renorm)
+
+    xf = data.astype(jnp.float32)
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, xf)
+    hmid = jax.nn.relu(
+        jnp.einsum("ecd,ehd->ech", expert_in,
+                   up_weight.astype(jnp.float32))
+        + up_bias.astype(jnp.float32)[:, None, :])
+    expert_out = jnp.einsum("ech,edh->ecd", hmid,
+                            down_weight.astype(jnp.float32)) \
+        + down_bias.astype(jnp.float32)[:, None, :]
+    out = jnp.einsum("tec,ecd->td", combine, expert_out)
+    return out.astype(data.dtype), aux
+
+
+@defop("_moe_ffn", num_outputs=2,
+       arg_names=["data", "router_weight", "up_weight", "up_bias",
+                  "down_weight", "down_bias"])
+def _moe_ffn(data, router_weight, up_weight, up_bias, down_weight,
+             down_bias, capacity_factor=1.25, renorm=True):
+    """Registry surface for :func:`moe_ffn_fn` (docstring above)."""
+    return moe_ffn_fn(data, router_weight, up_weight, up_bias,
+                      down_weight, down_bias,
+                      capacity_factor=float(capacity_factor),
+                      renorm=bool(renorm))

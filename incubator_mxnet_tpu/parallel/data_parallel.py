@@ -100,6 +100,19 @@ class ShardedTrainStep:
                                      **(optimizer_params or {}))
         else:
             self.opt = optimizer
+        if rules is None:
+            # model-parallel meshes get the default Megatron/expert
+            # rules out of the box: sharding is a LAYOUT choice, never
+            # a semantics change (XLA derives the collectives), so the
+            # only wrong default on a tp/ep mesh is full replication —
+            # it silently wastes the axes the user asked for
+            from .sharding import tp_rules_for_dense_stacks
+            if (self.mesh.shape.get("tp", 1) > 1
+                    or self.mesh.shape.get("ep", 1) > 1):
+                # hand-built meshes may define only some axes: rules
+                # touching absent axes drop to replicated
+                rules = tp_rules_for_dense_stacks().restrict_to_axes(
+                    self.mesh.axis_names)
         self.rules = rules or ShardingRules()
         self.batch_axis = batch_axis
         self.seq_axis = seq_axis

@@ -49,6 +49,26 @@ class ShardingRules:
         return {n: NamedSharding(mesh, self.spec_for(n, v.ndim))
                 for n, v in params.items()}
 
+    def restrict_to_axes(self, axis_names):
+        """Copy with rules referencing absent mesh axes dropped (their
+        params fall back to replicated).  Lets one default rule table
+        serve meshes that define only a subset of the standard axes
+        (e.g. a hand-built Mesh with ('dp', 'ep') but no 'tp')."""
+        axes = set(axis_names)
+
+        def ok(spec):
+            for el in spec:
+                if el is None:
+                    continue
+                els = el if isinstance(el, tuple) else (el,)
+                if any(a not in axes for a in els):
+                    return False
+            return True
+
+        return ShardingRules(
+            [(pat.pattern, spec) for pat, spec in self.rules
+             if ok(spec)], self.default)
+
 
 def tp_rules_for_dense_stacks():
     """Default Megatron-ish rules for blocks built from Dense layers
@@ -60,6 +80,11 @@ def tp_rules_for_dense_stacks():
     (ref: src/operator/fully_connected-inl.h weight shape).
     """
     return ShardingRules([
+        # expert-parallel (MoE): stacked expert weights shard their
+        # leading expert dim over 'ep' — GSPMD derives the token
+        # all-to-alls around the expert einsums (ops/moe.py)
+        (r"expert_(up|down)_weight$", P("ep", None, None)),
+        (r"expert_(up|down)_bias$", P("ep", None)),
         (r"(_up_|col|qkv|gate)\w*weight$", P("tp", None)),
         (r"(_down_|row|proj_o|out_proj)\w*weight$", P(None, "tp")),
         (r"(_up_|col|qkv|gate)\w*bias$", P("tp")),

@@ -1,0 +1,213 @@
+"""Mixture-of-Experts: top-2 routing op, Gluon MoEFFN, expert-parallel
+training on the mesh (ops/moe.py, parallel/sharding.py ep rules).
+
+A new-capability family per SURVEY §5's long-context/parallelism
+mandate — designed against the mesh's 'ep' axis the way ring
+attention is designed against 'sp'."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd, parallel
+from incubator_mxnet_tpu.gluon.model_zoo.transformer import (
+    MoEFFN, TransformerLM)
+from incubator_mxnet_tpu.ops.moe import moe_ffn_fn, top2_gating
+
+
+def _stacked(rs, e, h, d):
+    return (jnp.asarray(rs.randn(e, h, d) * 0.3, jnp.float32),
+            jnp.asarray(rs.randn(e, h) * 0.1, jnp.float32),
+            jnp.asarray(rs.randn(e, d, h) * 0.3, jnp.float32),
+            jnp.asarray(rs.randn(e, d) * 0.1, jnp.float32))
+
+
+def test_top2_gating_invariants():
+    rs = np.random.RandomState(0)
+    # c = 2t guarantees no overflow (2nd choices queue behind ALL
+    # 1st choices of their expert, so worst case needs 2t slots)
+    t, e, c = 64, 4, 128
+    logits = jnp.asarray(rs.randn(t, e), jnp.float32)
+    combine, dispatch, aux = top2_gating(logits, c)
+    assert combine.shape == dispatch.shape == (t, e, c)
+    # each expert buffer slot holds at most one token
+    assert float(jnp.max(jnp.sum(dispatch, axis=0))) <= 1.0 + 1e-6
+    # a token occupies at most 2 slots (top-2), gates sum to <= 1
+    per_tok = np.asarray(jnp.sum(dispatch, axis=(1, 2)))
+    assert (per_tok <= 2 + 1e-6).all()
+    gates = np.asarray(jnp.sum(combine, axis=(1, 2)))
+    assert (gates <= 1 + 1e-5).all()
+    # ample capacity: every token lands both choices
+    assert (per_tok == 2).all()
+    assert np.isfinite(float(aux)) and float(aux) > 0
+
+
+def test_identical_experts_match_dense_ffn():
+    """With renormalized top-2 gates and identical experts, MoE must
+    equal the plain FFN for every non-dropped token."""
+    rs = np.random.RandomState(1)
+    t, d, e, h = 48, 8, 4, 16
+    x = jnp.asarray(rs.randn(t, d), jnp.float32)
+    router = jnp.asarray(rs.randn(e, d) * 0.1, jnp.float32)
+    up1 = rs.randn(h, d).astype(np.float32) * 0.3
+    ub1 = rs.randn(h).astype(np.float32) * 0.1
+    dn1 = rs.randn(d, h).astype(np.float32) * 0.3
+    db1 = rs.randn(d).astype(np.float32) * 0.1
+    out, aux = moe_ffn_fn(
+        x, router, jnp.asarray(np.stack([up1] * e)),
+        jnp.asarray(np.stack([ub1] * e)),
+        jnp.asarray(np.stack([dn1] * e)),
+        jnp.asarray(np.stack([db1] * e)), capacity_factor=4.0)
+    want = np.maximum(np.asarray(x) @ up1.T + ub1, 0) @ dn1.T + db1
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_capacity_overflow_drops_tokens():
+    rs = np.random.RandomState(2)
+    t, d, e, h = 32, 8, 4, 8
+    x = jnp.asarray(rs.randn(t, d), jnp.float32)
+    router = jnp.asarray(rs.randn(e, d), jnp.float32)
+    w = _stacked(rs, e, h, d)
+    out, _ = moe_ffn_fn(x, router, *w, capacity_factor=0.01)
+    dropped = int(np.sum(np.all(np.asarray(out) == 0, axis=-1)))
+    assert dropped > 0              # overflow really drops
+    out2, _ = moe_ffn_fn(x, router, *w, capacity_factor=8.0)
+    assert int(np.sum(np.all(np.asarray(out2) == 0, axis=-1))) == 0
+
+
+def test_moe_op_on_tape():
+    """The _moe_ffn registry op records on the autograd tape and
+    gradients flow to every expert parameter."""
+    rs = np.random.RandomState(3)
+    t, d, e, h = 16, 4, 2, 8
+    x = nd.array(rs.randn(t, d).astype(np.float32))
+    router = nd.array(rs.randn(e, d).astype(np.float32))
+    up_w = nd.array((rs.randn(e, h, d) * 0.3).astype(np.float32))
+    up_b = nd.array(np.zeros((e, h), np.float32))
+    dn_w = nd.array((rs.randn(e, d, h) * 0.3).astype(np.float32))
+    dn_b = nd.array(np.zeros((e, d), np.float32))
+    for p in (router, up_w, dn_w):
+        p.attach_grad()
+    with autograd.record():
+        y, aux = nd._internal._moe_ffn(x, router, up_w, up_b, dn_w,
+                                       dn_b)
+        loss = (y * y).sum() + 0.01 * aux
+    loss.backward()
+    for p in (router, up_w, dn_w):
+        g = p.grad.asnumpy()
+        assert np.isfinite(g).all() and np.abs(g).max() > 0
+
+
+def test_moe_transformer_trains_on_ep_mesh():
+    """TransformerLM(moe_experts=4) through ShardedTrainStep on a
+    dp=4 x ep=2 mesh: expert weights sharded over 'ep', loss (incl.
+    aux) decreases, and the result matches the SAME model trained
+    ep=1 (expert parallelism must be a layout, not a semantics,
+    change)."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+
+    def run(mesh):
+        mx.random.seed(0)
+        net = TransformerLM(vocab_size=64, d_model=32, n_layers=2,
+                            n_heads=4, max_len=32, moe_experts=4)
+        net.initialize(mx.initializer.Xavier())
+
+        def lm_loss(outputs, labels):
+            logits, aux = outputs
+            lse = jax.nn.logsumexp(logits.astype(jnp.float32), -1)
+            picked = jnp.take_along_axis(
+                logits, labels[..., None], axis=-1)[..., 0]
+            ce = jnp.mean(lse - picked.astype(jnp.float32))
+            return ce + 0.01 * aux
+
+        ex = nd.array(np.zeros((2, 32), np.int32))
+        step = parallel.ShardedTrainStep(
+            net, optimizer="adam",
+            optimizer_params=dict(learning_rate=1e-3),
+            loss_fn=lm_loss, example_args=[ex], mesh=mesh)
+        rs = np.random.RandomState(0)
+        toks = np.asarray(rs.randint(0, 64, (8, 32)), np.int32)
+        labels = np.roll(toks, -1, axis=1)
+        losses = [float(step(toks, labels)) for _ in range(6)]
+        return losses
+
+    l_ep = run(parallel.make_mesh(dp=4, ep=2))
+    assert l_ep[-1] < l_ep[0], l_ep
+    l_dp = run(parallel.make_mesh(dp=8))
+    np.testing.assert_allclose(l_ep, l_dp, rtol=2e-4, atol=2e-4)
+
+
+def test_moe_expert_shards_placed_on_ep_axis():
+    """The ep rules actually shard the stacked expert dim."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    mx.random.seed(0)
+    net = TransformerLM(vocab_size=32, d_model=16, n_layers=1,
+                        n_heads=2, max_len=16, moe_experts=4)
+    net.initialize(mx.initializer.Xavier())
+    ex = nd.array(np.zeros((2, 16), np.int32))
+    step = parallel.ShardedTrainStep(
+        net, optimizer="sgd", optimizer_params=dict(learning_rate=.1),
+        loss_fn=lambda o, y: o[0].mean() + 0 * o[1],
+        example_args=[ex], mesh=parallel.make_mesh(dp=2, ep=4))
+    names = [n for n in step.params if "expert_up_weight" in n]
+    assert names, list(step.params)[:8]
+    arr = step.params[names[0]]
+    # 4 experts over ep=4: each shard holds exactly one expert
+    shard_shapes = {s.data.shape for s in arr.addressable_shards}
+    assert all(s[0] == 1 for s in shard_shapes), shard_shapes
+
+
+def test_moe_generate_matches_forward():
+    """KV-cache decode runs the SAME routing code as training."""
+    mx.random.seed(0)
+    net = TransformerLM(vocab_size=64, d_model=32, n_layers=2,
+                        n_heads=4, max_len=64, moe_experts=4)
+    net.initialize(mx.initializer.Xavier())
+    toks = nd.array(np.random.RandomState(0)
+                    .randint(0, 64, (2, 16)).astype(np.int32))
+    out = net.generate(toks, max_new_tokens=4)
+    logits, _ = net(toks)
+    nxt = logits.asnumpy()[:, -1].argmax(-1)
+    assert (out.asnumpy()[:, 16] == nxt).all()
+
+
+def test_partial_axis_mesh_gets_filtered_default_rules():
+    """A hand-built Mesh defining only ('dp', 'ep') must not crash on
+    the default rules' 'tp' specs — those fall back to replicated
+    while the ep rules still apply (review regression)."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+    from jax.sharding import Mesh
+    devs = np.array(jax.devices()[:4]).reshape(2, 2)
+    mesh = Mesh(devs, ("dp", "ep"))
+    mx.random.seed(0)
+    net = TransformerLM(vocab_size=32, d_model=16, n_layers=1,
+                        n_heads=2, max_len=16, moe_experts=2)
+    net.initialize(mx.initializer.Xavier())
+    ex = nd.array(np.zeros((2, 16), np.int32))
+    step = parallel.ShardedTrainStep(
+        net, optimizer="sgd", optimizer_params=dict(learning_rate=.1),
+        loss_fn=lambda o, y: o[0].mean() + 0 * o[1],
+        example_args=[ex], mesh=mesh)
+    name = [n for n in step.params if "expert_up_weight" in n][0]
+    shard_shapes = {s.data.shape
+                    for s in step.params[name].addressable_shards}
+    assert all(s[0] == 1 for s in shard_shapes), shard_shapes
+    # qkv (a 'tp' rule) replicated, not crashed
+    qkv = [n for n in step.params if "dense0_weight" in n][0]
+    full = step.params[qkv].shape
+    assert {s.data.shape for s in
+            step.params[qkv].addressable_shards} == {full}
+
+
+def test_cumsum_dtype_is_accumulator_type():
+    """numpy semantics: dtype upcasts BEFORE accumulation, so int8
+    data summing past 127 must not wrap (review regression)."""
+    x = nd.array(np.ones(200, np.int8))
+    out = nd.cumsum(x, dtype="int32")
+    assert out.asnumpy()[-1] == 200

@@ -175,3 +175,18 @@ def test_constant_first_arg():
     with autograd.record():
         y = nd.broadcast_mul(np.full(3, 2.0, "float32"), x)
     assert np.all(np.isfinite(y.asnumpy()))
+
+
+def test_memory_info():
+    """ctx.memory_info() / mx.tpu_memory_info(): (free, total) bytes
+    (the reference's mx.context.gpu_memory_info role).  On virtual
+    CPU devices the PJRT allocator exposes no stats, so host memory
+    is reported — still (free <= total), both positive."""
+    import incubator_mxnet_tpu as mx
+
+    for ctx in (mx.cpu(0), mx.tpu(0)):
+        free, total = ctx.memory_info()
+        assert 0 < free <= total, (ctx, free, total)
+    free, total = mx.tpu_memory_info(0)
+    assert 0 < free <= total
+    assert mx.gpu_memory_info is mx.tpu_memory_info
