@@ -44,6 +44,10 @@ def parse_args(argv=None):
                    help="micro-batches per step (memory lever)")
     p.add_argument("--remat", action="store_true",
                    help="rematerialize activations in backward")
+    p.add_argument("--moe-experts", type=int, default=0,
+                   help="swap dense MLPs for top-2-routed MoE with N "
+                   "experts (expert weights shard over the mesh's "
+                   "'ep' axis)")
     p.add_argument("--quick", action="store_true",
                    help="small run + convergence gate (CI)")
     return p.parse_args(argv)
@@ -72,14 +76,18 @@ def main(argv=None):
     net = TransformerLM(len(vocab), d_model=args.d_model,
                         n_layers=args.layers, n_heads=args.heads,
                         max_len=args.seq_len * 2,
-                        seq_parallel=args.seq_parallel)
+                        seq_parallel=args.seq_parallel,
+                        moe_experts=args.moe_experts)
     net.initialize(mx.initializer.Xavier())
 
     def lm_loss(outputs, labels):
         logits = outputs[0].astype(jnp.float32)
         logp = jax.nn.log_softmax(logits, axis=-1)
-        return -jnp.mean(
+        ce = -jnp.mean(
             jnp.take_along_axis(logp, labels[..., None], axis=-1))
+        if args.moe_experts:
+            ce = ce + 0.01 * outputs[1]   # router load-balance aux
+        return ce
 
     step = parallel.ShardedTrainStep(
         net, optimizer="adam",
@@ -115,7 +123,7 @@ def main(argv=None):
 
     summary = dict(first_loss=first_loss, final_loss=last_loss,
                    generated=gen, vocab=len(vocab),
-                   params=args.d_model)
+                   params=args.d_model, moe_experts=args.moe_experts)
     print(json.dumps(summary))
     if args.quick:
         assert last_loss < first_loss * 0.5, summary

@@ -81,3 +81,13 @@ def test_linear_classification_quick():
     assert summary["val_acc"] > 0.8
     # the sparse pull must actually be saving traffic
     assert summary["pull_savings"] > 0.25
+
+
+def test_transformer_lm_moe_quick():
+    """--moe-experts: the example trains a routed-MoE LM to the same
+    convergence gate, on the mesh, with the aux loss in the
+    objective."""
+    import transformer_lm as ex
+    summary = ex.main(["--quick", "--moe-experts", "4"])
+    assert summary["final_loss"] < summary["first_loss"] * 0.5
+    assert "fox" in summary["generated"]

@@ -68,15 +68,17 @@ def run_bench(mode, extra_env, timeout_s=1800, script="bench.py"):
             if isinstance(exc.stdout, bytes) else (exc.stdout or "")
         err = (exc.stderr or b"").decode("utf-8", "replace") \
             if isinstance(exc.stderr, bytes) else (exc.stderr or "")
-    parsed = None
-    for line in reversed(out.strip().splitlines()):
+    all_json = []
+    for line in out.strip().splitlines():
         try:
-            parsed = json.loads(line)
-            break
+            all_json.append(json.loads(line))
         except ValueError:
             continue
-    return {"mode": mode, "rc": rc, "seconds": round(time.time() - t0, 1),
-            "result": parsed, "stderr_tail": err[-1500:]}
+    return {"mode": mode, "rc": rc,
+            "seconds": round(time.time() - t0, 1),
+            "result": all_json[-1] if all_json else None,
+            "results": all_json,        # schema-stable: always a list
+            "stderr_tail": err[-1500:]}
 
 
 def main():
@@ -114,6 +116,7 @@ def main():
         for mode, env, script in [
                 ("flash_compile", {},
                  "tools/flash_compile_check.py"),
+                ("bandwidth", {}, "tools/bandwidth.py"),
                 ("resnet50", {}, "bench.py"),
                 ("transformer", {"MXTPU_BENCH_MODEL": "transformer"},
                  "bench.py"),
