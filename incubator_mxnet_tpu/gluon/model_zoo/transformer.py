@@ -37,10 +37,16 @@ class CausalSelfAttention(Block):
                  **kwargs):
         super().__init__(**kwargs)
         assert d_model % n_heads == 0
+        if seq_parallel not in (False, True, "ring", "ulysses"):
+            raise ValueError(
+                "seq_parallel must be False/True/'ring'/'ulysses', "
+                f"got {seq_parallel!r}")
         self._d = d_model
         self._h = n_heads
         self._dh = d_model // n_heads
-        self._seq_parallel = seq_parallel
+        # True == 'ring' (the default scheme; no head-count constraint)
+        self._seq_parallel = "ring" if seq_parallel is True \
+            else seq_parallel
         with self.name_scope():
             self.qkv = Dense(3 * d_model, flatten=False, use_bias=True)
             self.proj = Dense(d_model, flatten=False, use_bias=True)
@@ -75,8 +81,24 @@ class CausalSelfAttention(Block):
         mesh = self._ring_mesh(l)
         if mesh is not None:
             import jax
-            from ...parallel import ring_attention
-            out = ring_attention(
+            from ...parallel import ring_attention, ulysses_attention
+            # ulysses: all-to-all head sharding (needs h % sp == 0;
+            # otherwise the ring scheme covers the shape)
+            sp_fn = ring_attention
+            if self._seq_parallel == "ulysses":
+                if h % mesh.shape["sp"] == 0:
+                    sp_fn = ulysses_attention
+                elif not getattr(self, "_warned_ulysses", False):
+                    # one-time: the user asked for ulysses explicitly
+                    # and would otherwise misattribute ring's perf
+                    # profile to it
+                    from ...utils.log import get_logger
+                    get_logger().warning(
+                        "seq_parallel='ulysses' needs n_heads %% sp "
+                        "== 0 (heads=%d, sp=%d); using ring "
+                        "attention instead", h, mesh.shape["sp"])
+                    self._warned_ulysses = True
+            out = sp_fn(
                 q.reshape(b, l, h, dh)._data,
                 k.reshape(b, l, h, dh)._data,
                 v.reshape(b, l, h, dh)._data, mesh, causal=True)

@@ -11,7 +11,9 @@ the reference lacks (tensor, pipeline, sequence/ring).
   sharding        — parameter sharding rules (regex -> PartitionSpec)
   data_parallel   — ShardedTrainStep: one pjit step = fwd+bwd+psum+opt
   pipeline        — GPipe-style scan pipeline over 'pp'
-  ring_attention  — sequence parallelism over 'sp'
+  ring_attention  — sequence parallelism over 'sp' (ppermute ring)
+  ulysses_attention — sequence parallelism via all-to-all head
+                    sharding (DeepSpeed-Ulysses scheme)
 """
 from .mesh import (AXES, make_mesh, current_mesh, use_mesh,
                    named_sharding, replicated, shard_batch, P)
@@ -22,6 +24,7 @@ from .data_parallel import ShardedTrainStep
 from .symbol_step import SymbolTrainStep
 from .pipeline import pipeline_apply, stack_stage_params
 from .ring_attention import ring_attention, ring_attention_local
+from .ulysses import ulysses_attention, ulysses_attention_local
 
 __all__ = ["AXES", "make_mesh", "current_mesh", "use_mesh",
            "named_sharding", "replicated", "shard_batch", "P",
@@ -29,4 +32,5 @@ __all__ = ["AXES", "make_mesh", "current_mesh", "use_mesh",
            "tp_rules_for_dense_stacks", "constrain",
            "ShardedTrainStep", "SymbolTrainStep",
            "pipeline_apply", "stack_stage_params",
-           "ring_attention", "ring_attention_local"]
+           "ring_attention", "ring_attention_local",
+           "ulysses_attention", "ulysses_attention_local"]

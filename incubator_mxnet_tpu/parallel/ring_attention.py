@@ -21,7 +21,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-__all__ = ["ring_attention_local", "ring_attention"]
+__all__ = ["ring_attention_local", "ring_attention",
+           "shard_map_attention"]
 
 
 def ring_attention_local(q, k, v, axis_name="sp", causal=False,
@@ -73,10 +74,12 @@ def ring_attention_local(q, k, v, axis_name="sp", causal=False,
     return jnp.transpose(out, (0, 2, 1, 3))  # (B, Lq, H, D)
 
 
-def ring_attention(q, k, v, mesh, causal=False, scale=None,
-                   batch_axis="dp", seq_axis="sp"):
-    """shard_map wrapper: q/k/v are global (B, L, H, D) arrays laid
-    out with B over `batch_axis` and L over `seq_axis`."""
+def shard_map_attention(local_fn, q, k, v, mesh, batch_axis="dp",
+                        seq_axis="sp"):
+    """Shared shard_map wrapper for sequence-parallel attention
+    schemes (ring, ulysses): q/k/v are global (B, L, H, D) arrays
+    laid out with B over `batch_axis` and L over `seq_axis`;
+    ``local_fn(ql, kl, vl, axis_name)`` is the per-shard body."""
     if batch_axis is not None and \
             q.shape[0] % mesh.shape[batch_axis] != 0:
         batch_axis = None  # batch too small to split: replicate
@@ -93,7 +96,19 @@ def ring_attention(q, k, v, mesh, causal=False, scale=None,
                        in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
     def run(ql, kl, vl):
-        return ring_attention_local(ql, kl, vl, axis_name=seq_axis,
-                                    causal=causal, scale=scale)
+        return local_fn(ql, kl, vl, seq_axis)
 
     return run(q, k, v)
+
+
+def ring_attention(q, k, v, mesh, causal=False, scale=None,
+                   batch_axis="dp", seq_axis="sp"):
+    """shard_map wrapper: q/k/v are global (B, L, H, D) arrays laid
+    out with B over `batch_axis` and L over `seq_axis`."""
+    def body(ql, kl, vl, axis_name):
+        return ring_attention_local(ql, kl, vl, axis_name=axis_name,
+                                    causal=causal, scale=scale)
+
+    return shard_map_attention(body, q, k, v, mesh,
+                               batch_axis=batch_axis,
+                               seq_axis=seq_axis)

@@ -1,0 +1,86 @@
+"""Ulysses sequence parallelism: all-to-all head-sharded attention.
+
+The second long-context design SURVEY §5 prescribes alongside ring
+attention (DeepSpeed-Ulysses's scheme, done with XLA collectives):
+activations arrive sequence-sharded over the 'sp' axis; one
+`lax.all_to_all` re-shards them over HEADS (each device then holds the
+FULL sequence for H/sp heads), attention runs exactly and locally per
+head group, and a second all-to-all restores sequence sharding.
+
+Trade-off vs ring (parallel/ring_attention.py): Ulysses moves
+activations twice over ICI but runs attention as one dense local
+block per head group (better MXU utilization, no per-step ppermute
+latency on the critical path); ring never materializes the full
+sequence on any device (lower peak memory, overlaps transfer with
+compute).  Heads must divide by sp; ring has no such constraint —
+``CausalSelfAttention(seq_parallel='ulysses')`` falls back to ring
+when they don't.
+
+Differentiable end to end: `jax.grad` through all_to_all yields the
+reverse all-to-alls automatically.
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["ulysses_attention_local", "ulysses_attention"]
+
+
+def ulysses_attention_local(q, k, v, axis_name="sp", causal=False,
+                            scale=None):
+    """Ulysses body — call inside shard_map over `axis_name`.
+
+    q/k/v: (batch, seq_local, heads, head_dim); heads % sp == 0.
+    """
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+
+    def to_heads(t):
+        # (B, L/n, H, D) -> (B, L, H/n, D): gather sequence, split
+        # heads — ONE all-to-all over ICI
+        return lax.all_to_all(t, axis_name, split_axis=2,
+                              concat_axis=1, tiled=True)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    l_full = qh.shape[1]
+
+    s = jnp.einsum("bqhd,bkhd->bhqk",
+                   qh.astype(jnp.float32) * scale,
+                   kh.astype(jnp.float32))
+    if causal:
+        pos = jnp.arange(l_full)
+        mask = pos[:, None] >= pos[None, :]
+        s = jnp.where(mask[None, None], s, -1e30)
+    att = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bkhd->bqhd", att,
+                   vh.astype(jnp.float32)).astype(q.dtype)
+
+    # (B, L, H/n, D) -> (B, L/n, H, D): back to sequence sharding
+    return lax.all_to_all(o, axis_name, split_axis=1,
+                          concat_axis=2, tiled=True)
+
+
+def ulysses_attention(q, k, v, mesh, causal=False, scale=None,
+                      batch_axis="dp", seq_axis="sp"):
+    """shard_map wrapper: q/k/v are global (B, L, H, D) arrays laid
+    out with B over `batch_axis` and L over `seq_axis` (same calling
+    convention as parallel.ring_attention)."""
+    sp = mesh.shape[seq_axis]
+    h = q.shape[2]
+    if h % sp != 0:
+        raise ValueError(
+            f"ulysses needs heads % sp == 0 (heads={h}, sp={sp}); "
+            "use ring attention for this shape")
+    from .ring_attention import shard_map_attention
+
+    def body(ql, kl, vl, axis_name):
+        return ulysses_attention_local(ql, kl, vl,
+                                       axis_name=axis_name,
+                                       causal=causal, scale=scale)
+
+    return shard_map_attention(body, q, k, v, mesh,
+                               batch_axis=batch_axis,
+                               seq_axis=seq_axis)
