@@ -154,6 +154,7 @@ def _bench_transformer(dev, platform):
     cpu = jax.devices("cpu")[0]
     B = int(os.environ.get("MXTPU_BENCH_BATCH", "8"))
     L = int(os.environ.get("MXTPU_BENCH_SEQ", "1024"))
+    MOE = int(os.environ.get("MXTPU_BENCH_MOE", "0"))
     V, D, LAYERS, HEADS = 32000, 1024, 12, 16
 
     # the flash kernel has only ever been interpret-verified off-TPU;
@@ -180,7 +181,8 @@ def _bench_transformer(dev, platform):
     with jax.default_device(cpu):
         mx.random.seed(0)
         net = TransformerLM(V, d_model=D, n_layers=LAYERS,
-                            n_heads=HEADS, max_len=L)
+                            n_heads=HEADS, max_len=L,
+                            moe_experts=MOE)
         net.initialize(mx.initializer.Xavier())
         ex = mx.nd.array(np.zeros((2, L), "int32"))
 
@@ -193,7 +195,10 @@ def _bench_transformer(dev, platform):
         lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
         picked = jnp.take_along_axis(
             logits, labels[..., None], axis=-1)[..., 0]
-        return jnp.mean(lse - picked.astype(jnp.float32))
+        ce = jnp.mean(lse - picked.astype(jnp.float32))
+        if MOE:
+            ce = ce + 0.01 * outputs[1]   # router load-balance aux
+        return ce
 
     mesh_devs = [dev] if dev is not None else jax.devices("cpu")[:1]
     compute_dtype = jnp.bfloat16 if platform != "cpu" else None
@@ -232,8 +237,8 @@ def _bench_transformer(dev, platform):
     mfu = (flops_tok * tok_s / peak) if peak else None
     assert np.isfinite(final_loss), final_loss
     print(json.dumps({
-        "metric": f"transformer_lm_150m_train_tokens_per_sec_"
-                  f"batch{B}_seq{L}_1chip",
+        "metric": f"transformer_lm_150m{'_moe%d' % MOE if MOE else ''}"
+                  f"_train_tokens_per_sec_batch{B}_seq{L}_1chip",
         "value": round(tok_s, 1),
         "unit": "tokens/sec",
         "vs_baseline": None,   # the reference predates transformers

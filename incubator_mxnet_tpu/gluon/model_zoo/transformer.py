@@ -88,16 +88,15 @@ class CausalSelfAttention(Block):
             if self._seq_parallel == "ulysses":
                 if h % mesh.shape["sp"] == 0:
                     sp_fn = ulysses_attention
-                elif not getattr(self, "_warned_ulysses", False):
-                    # one-time: the user asked for ulysses explicitly
-                    # and would otherwise misattribute ring's perf
-                    # profile to it
+                elif not globals().get("_ULYSSES_WARNED"):
+                    # once per process (a per-layer flag would log
+                    # the identical line n_layers times)
                     from ...utils.log import get_logger
                     get_logger().warning(
                         "seq_parallel='ulysses' needs n_heads %% sp "
                         "== 0 (heads=%d, sp=%d); using ring "
                         "attention instead", h, mesh.shape["sp"])
-                    self._warned_ulysses = True
+                    globals()["_ULYSSES_WARNED"] = True
             out = sp_fn(
                 q.reshape(b, l, h, dh)._data,
                 k.reshape(b, l, h, dh)._data,
@@ -240,6 +239,7 @@ class TransformerLM(Block):
         super().__init__(**kwargs)
         self._d = d_model
         self._max_len = max_len
+        self._mlp_ratio = mlp_ratio
         self.moe_experts = moe_experts
         with self.name_scope():
             self.embed = Embedding(vocab_size, d_model)
@@ -520,12 +520,20 @@ class TransformerLM(Block):
 
     def train_flops_per_token(self, seq_len):
         """Deterministic matmul-FLOPs per token for one fwd+bwd step
-        (the 3x-forward rule), for MFU accounting."""
+        (the 3x-forward rule), for MFU accounting.  MoE: each token
+        runs TWO experts' FFNs (top-2 routing) plus the router."""
         d = self._d
+        hid = self._mlp_ratio * d
+        if self.moe_experts:
+            e = self.moe_experts
+            # top-2: 2x one expert's up+down, + router matmul
+            mlp = 2 * (2 * 2 * d * hid) + 2 * d * e
+        else:
+            mlp = 2 * 2 * d * hid          # dense up+down
         per_layer = (2 * d * 3 * d          # qkv
                      + 2 * d * d            # proj
                      + 2 * 2 * seq_len * d  # scores + att@v
-                     + 2 * 2 * d * 4 * d)   # mlp up+down
+                     + mlp)
         vocab = self.head._units
         fwd = self.n_layers * per_layer + 2 * d * vocab
         return 3 * fwd

@@ -22,7 +22,6 @@ DROPPED — their expert contribution is zero and the residual stream
 carries them, the standard GShard overflow semantic that keeps shapes
 static.
 """
-import functools
 import math
 
 import jax

@@ -47,16 +47,18 @@ def ulysses_attention_local(q, k, v, axis_name="sp", causal=False,
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
     l_full = qh.shape[1]
 
-    s = jnp.einsum("bqhd,bkhd->bhqk",
-                   qh.astype(jnp.float32) * scale,
-                   kh.astype(jnp.float32))
+    # matmuls stay in the compute dtype (bf16 on TPU -> full-rate
+    # MXU) with fp32 ACCUMULATION; only the softmax reduction is
+    # carried in fp32 — same split as ring/flash
+    s = jnp.einsum("bqhd,bkhd->bhqk", qh * scale, kh,
+                   preferred_element_type=jnp.float32)
     if causal:
         pos = jnp.arange(l_full)
         mask = pos[:, None] >= pos[None, :]
         s = jnp.where(mask[None, None], s, -1e30)
-    att = jax.nn.softmax(s, axis=-1)
-    o = jnp.einsum("bhqk,bkhd->bqhd", att,
-                   vh.astype(jnp.float32)).astype(q.dtype)
+    att = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    o = jnp.einsum("bhqk,bkhd->bqhd", att, vh,
+                   preferred_element_type=jnp.float32).astype(q.dtype)
 
     # (B, L, H/n, D) -> (B, L/n, H, D): back to sequence sharding
     return lax.all_to_all(o, axis_name, split_axis=1,

@@ -211,3 +211,37 @@ def test_cumsum_dtype_is_accumulator_type():
     x = nd.array(np.ones(200, np.int8))
     out = nd.cumsum(x, dtype="int32")
     assert out.asnumpy()[-1] == 200
+
+
+def test_moe_bf16_compute_dtype():
+    """MoE under ShardedTrainStep(compute_dtype=bfloat16): gating
+    runs fp32 internally, the step stays finite and learns (the
+    bench's hardware configuration)."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices")
+    mx.random.seed(0)
+    net = TransformerLM(vocab_size=64, d_model=32, n_layers=2,
+                        n_heads=4, max_len=16, moe_experts=4)
+    net.initialize(mx.initializer.Xavier())
+
+    def lm_loss(outputs, labels):
+        logits, aux = outputs
+        lse = jax.nn.logsumexp(logits.astype(jnp.float32), -1)
+        picked = jnp.take_along_axis(
+            logits, labels[..., None], axis=-1)[..., 0]
+        return jnp.mean(lse - picked.astype(jnp.float32)) \
+            + 0.01 * aux
+
+    ex = nd.array(np.zeros((2, 16), np.int32))
+    step = parallel.ShardedTrainStep(
+        net, optimizer="adam",
+        optimizer_params=dict(learning_rate=1e-3),
+        loss_fn=lm_loss, example_args=[ex],
+        mesh=parallel.make_mesh(dp=1, ep=2),
+        compute_dtype=jnp.bfloat16)
+    rs = np.random.RandomState(0)
+    toks = np.asarray(rs.randint(0, 64, (4, 16)), np.int32)
+    labels = np.roll(toks, -1, axis=1)
+    losses = [float(step(toks, labels)) for _ in range(8)]
+    assert all(np.isfinite(l) for l in losses), losses
+    assert losses[-1] < losses[0], losses

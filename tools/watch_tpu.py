@@ -123,6 +123,10 @@ def main():
                 ("transformer_b32",
                  {"MXTPU_BENCH_MODEL": "transformer",
                   "MXTPU_BENCH_BATCH": "32"}, "bench.py"),
+                ("transformer_l4096",   # long-context: streaming
+                 {"MXTPU_BENCH_MODEL": "transformer",  # flash path
+                  "MXTPU_BENCH_BATCH": "2",
+                  "MXTPU_BENCH_SEQ": "4096"}, "bench.py"),
                 ("resnet50_b128", {"MXTPU_BENCH_BATCH": "128"},
                  "bench.py"),
                 ("pipeline", {"MXTPU_BENCH_MODEL": "pipeline"},
