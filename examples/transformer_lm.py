@@ -38,7 +38,8 @@ def parse_args(argv=None):
     p.add_argument("--lr", type=float, default=3e-3)
     p.add_argument("--bf16", action="store_true",
                    help="bf16 compute with fp32 masters")
-    p.add_argument("--seq-parallel", action="store_true",
+    p.add_argument("--seq-parallel", nargs="?", const="ring",
+                   default=False, choices=["ring", "ulysses"],
                    help="ring attention over the mesh 'sp' axis")
     p.add_argument("--grad-accum", type=int, default=1,
                    help="micro-batches per step (memory lever)")
@@ -92,7 +93,8 @@ def main(argv=None):
     step = parallel.ShardedTrainStep(
         net, optimizer="adam",
         optimizer_params=dict(learning_rate=args.lr),
-        loss_fn=lm_loss, seq_axis=1 if args.seq_parallel else None,
+        loss_fn=lm_loss,
+        seq_axis=1 if args.seq_parallel else None,
         example_args=[mx.nd.array(
             np.zeros((2, args.seq_len), "int32"))],
         compute_dtype=jnp.bfloat16 if args.bf16 else None,

@@ -20,6 +20,9 @@ from ..nn import Dense, Dropout, Embedding, LayerNorm
 __all__ = ["TransformerLM", "TransformerBlock", "CausalSelfAttention",
            "transformer_lm"]
 
+# once-per-process notice when an explicit ulysses request falls back
+_ULYSSES_WARNED = False
+
 
 class CausalSelfAttention(Block):
     """Multi-head causal self-attention over registry ops.
@@ -88,15 +91,18 @@ class CausalSelfAttention(Block):
             if self._seq_parallel == "ulysses":
                 if h % mesh.shape["sp"] == 0:
                     sp_fn = ulysses_attention
-                elif not globals().get("_ULYSSES_WARNED"):
+                else:
                     # once per process (a per-layer flag would log
                     # the identical line n_layers times)
-                    from ...utils.log import get_logger
-                    get_logger().warning(
-                        "seq_parallel='ulysses' needs n_heads %% sp "
-                        "== 0 (heads=%d, sp=%d); using ring "
-                        "attention instead", h, mesh.shape["sp"])
-                    globals()["_ULYSSES_WARNED"] = True
+                    global _ULYSSES_WARNED
+                    if not _ULYSSES_WARNED:
+                        from ...utils.log import get_logger
+                        get_logger().warning(
+                            "seq_parallel='ulysses' needs n_heads "
+                            "%% sp == 0 (heads=%d, sp=%d); using "
+                            "ring attention instead", h,
+                            mesh.shape["sp"])
+                        _ULYSSES_WARNED = True
             out = sp_fn(
                 q.reshape(b, l, h, dh)._data,
                 k.reshape(b, l, h, dh)._data,

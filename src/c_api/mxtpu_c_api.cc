@@ -161,6 +161,36 @@ def nd_load(fname):
     return list(data), ["" for _ in data]
 
 
+def ag_set_recording(flag):
+    # direct thread-local state set (autograd.set_recording) — the
+    # reference's MXAutogradSetIsRecording semantics; composes with
+    # Python-side record() scopes instead of shadow-stacking them
+    from incubator_mxnet_tpu import autograd
+    return 1 if autograd.set_recording(bool(flag)) else 0
+
+
+def ag_is_recording():
+    from incubator_mxnet_tpu import autograd
+    return 1 if autograd.is_recording() else 0
+
+
+def ag_mark_variable(arr):
+    arr.attach_grad()
+
+
+def ag_backward(head):
+    head.backward()
+
+
+def ag_get_grad(arr):
+    g = arr.grad
+    if g is None:
+        raise ValueError(
+            "array has no gradient: MXAutogradMarkVariable it "
+            "BEFORE recording, and run MXAutogradBackward first")
+    return g.copy()
+
+
 def sym_variable(name):
     import incubator_mxnet_tpu as mx
     return mx.sym.Variable(name)
@@ -680,6 +710,55 @@ int MXImperativeInvoke(const char *op_name, int num_inputs,
   }
   Py_DECREF(r);
   *num_outputs = static_cast<int>(n);
+  return 0;
+}
+
+int MXAutogradSetIsRecording(int recording, int *prev) {
+  if (ensure_runtime() != 0) return -1;
+  GIL gil;
+  PyObject *r = glue_call("ag_set_recording", "(i)", recording);
+  if (r == nullptr) return -1;
+  if (prev != nullptr) *prev = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXAutogradIsRecording(int *out) {
+  if (ensure_runtime() != 0) return -1;
+  GIL gil;
+  PyObject *r = glue_call("ag_is_recording", "()");
+  if (r == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXAutogradMarkVariable(NDArrayHandle handle) {
+  auto *h = static_cast<NDHandle *>(handle);
+  GIL gil;
+  PyObject *r = glue_call("ag_mark_variable", "(O)", h->obj);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXAutogradBackward(NDArrayHandle head) {
+  auto *h = static_cast<NDHandle *>(head);
+  GIL gil;
+  PyObject *r = glue_call("ag_backward", "(O)", h->obj);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXAutogradGetGrad(NDArrayHandle handle, NDArrayHandle *out) {
+  auto *h = static_cast<NDHandle *>(handle);
+  GIL gil;
+  PyObject *g = glue_call("ag_get_grad", "(O)", h->obj);
+  if (g == nullptr) return -1;
+  auto *nh = new NDHandle();
+  nh->obj = g;
+  *out = nh;
   return 0;
 }
 

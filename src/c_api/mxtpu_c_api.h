@@ -115,6 +115,27 @@ int MXImperativeInvoke(const char *op_name, int num_inputs,
                        const char **param_keys,
                        const char **param_vals);
 
+/* --------------------------------------------------------- Autograd */
+
+/* Imperative differentiation from C (ref: MXAutogradSetIsRecording /
+ * MXAutogradMarkVariables / MXAutogradBackward, c_api_ndarray.cc).
+ * Flow: attach grads to inputs -> SetRecording(1) -> invoke ops ->
+ * SetRecording(0) -> Backward(loss) -> GetGrad per input. */
+int MXAutogradSetIsRecording(int recording, int *prev);
+int MXAutogradIsRecording(int *out);
+
+/* Allocate a gradient buffer for this array and mark it as a
+ * differentiation target (ref: MXAutogradMarkVariables). */
+int MXAutogradMarkVariable(NDArrayHandle handle);
+
+/* Reverse pass from `head` (summed if non-scalar, the reference's
+ * ones-like head grad); gradients land on marked variables. */
+int MXAutogradBackward(NDArrayHandle head);
+
+/* The gradient accumulated on a marked array, as a NEW handle the
+ * caller frees; error if none. */
+int MXAutogradGetGrad(NDArrayHandle handle, NDArrayHandle *out);
+
 /* ----------------------------------------------------------- Symbol */
 
 typedef void *SymbolHandle;

@@ -591,3 +591,67 @@ def test_c_symbol_compose_and_native_train(tmp_path):
     # 0.7 bound per the suite convention (test_bucketing.py): the
     # demo's Xavier init is unseeded, so leave convergence headroom
     assert 0 < last < 0.7 * first, (first, last)
+
+
+def test_autograd_from_c():
+    """Imperative differentiation through the C ABI (the reference's
+    MXAutograd* family): mark -> record -> invoke ops -> backward ->
+    read gradients, no Python in the flow."""
+    lib = _bind(ctypes.CDLL(_build_lib()))
+    lib.MXAutogradSetIsRecording.argtypes = [
+        ctypes.c_int, ctypes.POINTER(ctypes.c_int)]
+    lib.MXAutogradIsRecording.argtypes = [ctypes.POINTER(ctypes.c_int)]
+    lib.MXAutogradMarkVariable.argtypes = [ctypes.c_void_p]
+    lib.MXAutogradBackward.argtypes = [ctypes.c_void_p]
+    lib.MXAutogradGetGrad.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p)]
+
+    rs = np.random.RandomState(0)
+    x = rs.rand(3, 4).astype(np.float32)
+    w = rs.rand(4, 2).astype(np.float32)
+    hx, hw = _nd_from_np(lib, x), _nd_from_np(lib, w)
+    assert lib.MXAutogradMarkVariable(hw) == 0
+
+    prev = ctypes.c_int(-1)
+    assert lib.MXAutogradSetIsRecording(1, ctypes.byref(prev)) == 0
+    assert prev.value == 0
+    rec = ctypes.c_int(0)
+    assert lib.MXAutogradIsRecording(ctypes.byref(rec)) == 0
+    assert rec.value == 1
+
+    ins = (ctypes.c_void_p * 2)(hx, hw)
+    outs = (ctypes.c_void_p * 4)()
+    n_out = ctypes.c_int(4)
+    assert lib.MXImperativeInvoke(b"dot", 2, ins,
+                                  ctypes.byref(n_out), outs, 0,
+                                  None, None) == 0
+    hxw = outs[0]
+    n_out.value = 4
+    assert lib.MXImperativeInvoke(b"relu", 1,
+                                  (ctypes.c_void_p * 1)(hxw),
+                                  ctypes.byref(n_out), outs, 0,
+                                  None, None) == 0
+    hr = outs[0]
+    n_out.value = 4
+    assert lib.MXImperativeInvoke(b"sum", 1,
+                                  (ctypes.c_void_p * 1)(hr),
+                                  ctypes.byref(n_out), outs, 0,
+                                  None, None) == 0
+    hloss = outs[0]
+    assert lib.MXAutogradSetIsRecording(0, ctypes.byref(prev)) == 0
+    assert lib.MXAutogradBackward(hloss) == 0
+
+    hg = ctypes.c_void_p()
+    assert lib.MXAutogradGetGrad(hw, ctypes.byref(hg)) == 0, \
+        lib.MXTPUCApiGetLastError()
+    got = _np_from_nd(lib, hg)
+    # oracle: d/dw sum(relu(x @ w)) = x.T @ 1[xw > 0]
+    want = x.T @ (x @ w > 0).astype(np.float32)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    # unmarked array: clean error
+    hg2 = ctypes.c_void_p()
+    assert lib.MXAutogradGetGrad(hx, ctypes.byref(hg2)) == -1
+    assert b"no gradient" in lib.MXTPUCApiGetLastError()
+    for h in (hx, hw, hxw, hr, hloss, hg):
+        lib.MXNDArrayFree(h)
