@@ -37,13 +37,14 @@ class CausalSelfAttention(Block):
     """
 
     def __init__(self, d_model, n_heads, seq_parallel=False,
-                 **kwargs):
+                 rope=False, **kwargs):
         super().__init__(**kwargs)
         assert d_model % n_heads == 0
         if seq_parallel not in (False, True, "ring", "ulysses"):
             raise ValueError(
                 "seq_parallel must be False/True/'ring'/'ulysses', "
                 f"got {seq_parallel!r}")
+        self._rope = bool(rope)
         self._d = d_model
         self._h = n_heads
         self._dh = d_model // n_heads
@@ -80,6 +81,14 @@ class CausalSelfAttention(Block):
         h, dh = self._h, self._dh
         qkv = self.qkv(x)                          # (B, L, 3D)
         q, k, v = nd.split(qkv, num_outputs=3, axis=2)
+
+        if self._rope:
+            # rotate q/k per head BEFORE any sequence sharding:
+            # positions are global along axis 1 (ops/matrix.rope_fn)
+            q = nd._internal._rope(
+                q.reshape(b, l, h, dh)).reshape(b, l, d)
+            k = nd._internal._rope(
+                k.reshape(b, l, h, dh)).reshape(b, l, d)
 
         mesh = self._ring_mesh(l)
         if mesh is not None:
@@ -204,13 +213,14 @@ class TransformerBlock(Block):
 
     def __init__(self, d_model, n_heads, mlp_ratio=4, dropout=0.0,
                  seq_parallel=False, moe_experts=0,
-                 moe_capacity_factor=1.25, **kwargs):
+                 moe_capacity_factor=1.25, rope=False, **kwargs):
         super().__init__(**kwargs)
         self.moe_experts = moe_experts
         with self.name_scope():
             self.ln1 = LayerNorm()
             self.attn = CausalSelfAttention(d_model, n_heads,
-                                            seq_parallel=seq_parallel)
+                                            seq_parallel=seq_parallel,
+                                            rope=rope)
             self.ln2 = LayerNorm()
             if moe_experts:
                 self.moe = MoEFFN(d_model, moe_experts,
@@ -241,21 +251,27 @@ class TransformerLM(Block):
     def __init__(self, vocab_size, d_model=512, n_layers=6,
                  n_heads=8, max_len=1024, mlp_ratio=4, dropout=0.0,
                  seq_parallel=False, moe_experts=0,
-                 moe_capacity_factor=1.25, **kwargs):
+                 moe_capacity_factor=1.25, pos="learned", **kwargs):
         super().__init__(**kwargs)
+        if pos not in ("learned", "rope"):
+            raise ValueError(
+                f"pos must be 'learned' or 'rope', got {pos!r}")
         self._d = d_model
         self._max_len = max_len
         self._mlp_ratio = mlp_ratio
+        self._pos_kind = pos
         self.moe_experts = moe_experts
         with self.name_scope():
             self.embed = Embedding(vocab_size, d_model)
-            self.pos = Embedding(max_len, d_model)
+            if pos == "learned":
+                self.pos = Embedding(max_len, d_model)
             self.blocks = [
                 TransformerBlock(d_model, n_heads, mlp_ratio, dropout,
                                  seq_parallel=seq_parallel,
                                  moe_experts=moe_experts,
                                  moe_capacity_factor=
-                                 moe_capacity_factor)
+                                 moe_capacity_factor,
+                                 rope=(pos == "rope"))
                 for _ in range(n_layers)]
             for i, blk in enumerate(self.blocks):
                 setattr(self, f"block{i}", blk)   # register children
@@ -273,9 +289,10 @@ class TransformerLM(Block):
         if l > self._max_len:
             raise ValueError(
                 f"sequence {l} exceeds max_len {self._max_len}")
-        pos = nd.arange(l).astype("int32")
         x = self.embed(tokens) * math.sqrt(self._d)
-        x = nd.broadcast_add(x, self.pos(pos).expand_dims(0))
+        if self._pos_kind == "learned":
+            pos = nd.arange(l).astype("int32")
+            x = nd.broadcast_add(x, self.pos(pos).expand_dims(0))
         aux = None
         for blk in self.blocks:
             x = blk(x)
@@ -378,9 +395,12 @@ class TransformerLM(Block):
                 lw["up"] = (w(blk.up.weight), w(blk.up.bias))
                 lw["down"] = (w(blk.down.weight), w(blk.down.bias))
             layers.append(lw)
-        return dict(embed=w(self.embed.weight), pos=w(self.pos.weight),
-                    ln_f=(w(self.ln_f.gamma), w(self.ln_f.beta)),
-                    head=w(self.head.weight), layers=layers)
+        wts = dict(embed=w(self.embed.weight),
+                   ln_f=(w(self.ln_f.gamma), w(self.ln_f.beta)),
+                   head=w(self.head.weight), layers=layers)
+        if self._pos_kind == "learned":
+            wts["pos"] = w(self.pos.weight)
+        return wts
 
     def _build_decode(self, b, p, max_new, sample, top_k=0,
                       top_p=1.0):
@@ -392,6 +412,8 @@ class TransformerLM(Block):
         dh = d // h
         total = p + max_new
         scale = math.sqrt(d)
+        use_rope = self._pos_kind == "rope"
+        from ...ops.matrix import rope_fn
 
         def ln(x, gb):
             mu = jnp.mean(x, -1, keepdims=True)
@@ -445,16 +467,21 @@ class TransformerLM(Block):
             """Batched forward over the whole prompt: seeds the KV
             caches in one pass and returns the last position's
             logits (same math as the per-token step)."""
-            x = wts["embed"][prompt] * scale \
-                + wts["pos"][jnp.arange(p)]            # (B, P, D)
+            x = wts["embed"][prompt] * scale       # (B, P, D)
+            if not use_rope:
+                x = x + wts["pos"][jnp.arange(p)]
             mask = jnp.tril(jnp.ones((p, p), bool))
             caches = []
             for lw, cf in zip(wts["layers"], cfs):
                 xa = ln(x, lw["ln1"])
                 qkv = xa @ lw["qkv"][0].T + lw["qkv"][1]
                 q, k, v = jnp.split(qkv, 3, axis=-1)
-                q = q.reshape(b, p, h, dh).transpose(0, 2, 1, 3)
-                k = k.reshape(b, p, h, dh).transpose(0, 2, 1, 3)
+                q = q.reshape(b, p, h, dh)
+                k = k.reshape(b, p, h, dh)
+                if use_rope:
+                    q, k = rope_fn(q), rope_fn(k)
+                q = q.transpose(0, 2, 1, 3)
+                k = k.transpose(0, 2, 1, 3)
                 v = v.reshape(b, p, h, dh).transpose(0, 2, 1, 3)
                 kc = jnp.zeros((b, h, total, dh),
                                jnp.float32).at[:, :, :p].set(k)
@@ -485,14 +512,23 @@ class TransformerLM(Block):
                 toks, caches, rng = carry
                 tok = lax.dynamic_index_in_dim(toks, i, axis=1,
                                                keepdims=False)
-                x = wts["embed"][tok] * scale + wts["pos"][i]
+                x = wts["embed"][tok] * scale
+                if not use_rope:
+                    x = x + wts["pos"][i]
                 new_caches = []
                 for (lw, cf), (kc, vc) in zip(
                         zip(wts["layers"], cfs), caches):
                     xa = ln(x, lw["ln1"])
                     qkv = xa @ lw["qkv"][0].T + lw["qkv"][1]
                     q, k, v = jnp.split(qkv, 3, axis=-1)
-                    q = q.reshape(b, h, dh)
+                    if use_rope:
+                        # this token sits at absolute position i
+                        q = rope_fn(q.reshape(b, 1, h, dh),
+                                    offset=i).reshape(b, h, dh)
+                        k = rope_fn(k.reshape(b, 1, h, dh),
+                                    offset=i).reshape(b, h, dh)
+                    else:
+                        q = q.reshape(b, h, dh)
                     kc = lax.dynamic_update_index_in_dim(
                         kc, k.reshape(b, h, dh), i, axis=2)
                     vc = lax.dynamic_update_index_in_dim(

@@ -297,3 +297,36 @@ def cumsum(data, axis=None, dtype=None):
     from ..base import np_dtype
     return jnp.cumsum(data, axis=axis,
                       dtype=np_dtype(dtype) if dtype else None)
+
+
+def rope_fn(data, base=10000.0, offset=0):
+    """Rotary position embedding (RoFormer; a positional scheme the
+    reference predates but LM users expect).  data: (B_, L, D) or
+    (B, L, H, D) — positions run along axis 1 either way.  Rotates
+    feature pairs (d, d + D/2) by position-dependent angles; applied
+    to q and k, attention scores become functions of RELATIVE
+    position.  ``offset`` shifts the absolute positions (may be a
+    traced scalar — the KV-cache decode path passes the step index).
+    """
+    l, d = data.shape[1], data.shape[-1]
+    if d % 2:
+        raise ValueError(
+            f"rope needs an even feature dim (got {d}): it rotates "
+            "pairs (i, i + D/2) — pick d_model/n_heads even")
+    half = d // 2
+    pos = jnp.arange(l, dtype=jnp.float32) + offset
+    inv = float(base) ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    ang = pos[:, None] * inv[None, :]              # (L, D/2)
+    shape = (1, l) + (1,) * (data.ndim - 3) + (half,)
+    cos = jnp.cos(ang).reshape(shape)
+    sin = jnp.sin(ang).reshape(shape)
+    x1, x2 = data[..., :half], data[..., half:]
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(data.dtype)
+
+
+@defop("_rope", arg_names=["data"])
+def rope(data, base=10000.0, offset=0):
+    """Registry surface for :func:`rope_fn` (docstring above)."""
+    return rope_fn(data, base=float(base), offset=float(offset))

@@ -216,6 +216,7 @@ SPECS = {
     "ElementWiseSum": dict(inputs=[P(2, 3), P(2, 3)]),
     "einsum": dict(inputs=[P(3, 4), P(4, 5)],
                    params=dict(subscripts="ij,jk->ik")),
+    "_rope": dict(inputs=[P(2, 4, 8)]),   # head dim must be even
 }
 
 SKIP = set(
