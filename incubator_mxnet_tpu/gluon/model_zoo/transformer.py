@@ -37,22 +37,32 @@ class CausalSelfAttention(Block):
     """
 
     def __init__(self, d_model, n_heads, seq_parallel=False,
-                 rope=False, **kwargs):
+                 rope=False, n_kv_heads=None, **kwargs):
         super().__init__(**kwargs)
         assert d_model % n_heads == 0
         if seq_parallel not in (False, True, "ring", "ulysses"):
             raise ValueError(
                 "seq_parallel must be False/True/'ring'/'ulysses', "
                 f"got {seq_parallel!r}")
+        kv = n_kv_heads if n_kv_heads is not None else n_heads
+        if kv <= 0 or n_heads % kv:
+            raise ValueError(
+                f"n_heads ({n_heads}) must be a positive multiple of "
+                f"n_kv_heads ({kv})")
         self._rope = bool(rope)
         self._d = d_model
         self._h = n_heads
+        self._kv = kv
         self._dh = d_model // n_heads
         # True == 'ring' (the default scheme; no head-count constraint)
         self._seq_parallel = "ring" if seq_parallel is True \
             else seq_parallel
         with self.name_scope():
-            self.qkv = Dense(3 * d_model, flatten=False, use_bias=True)
+            # grouped-query attention: kv projections carry only
+            # n_kv_heads head groups (the KV cache and the k/v
+            # parameter cost shrink by n_heads/n_kv_heads)
+            self.qkv = Dense(d_model + 2 * kv * self._dh,
+                             flatten=False, use_bias=True)
             self.proj = Dense(d_model, flatten=False, use_bias=True)
 
     def _ring_mesh(self, seq_len):
@@ -78,9 +88,21 @@ class CausalSelfAttention(Block):
 
     def forward(self, x):
         b, l, d = x.shape
-        h, dh = self._h, self._dh
-        qkv = self.qkv(x)                          # (B, L, 3D)
-        q, k, v = nd.split(qkv, num_outputs=3, axis=2)
+        h, dh, kv = self._h, self._dh, self._kv
+        kvd = kv * dh
+        qkv = self.qkv(x)                   # (B, L, D + 2*KV*dh)
+        q = nd.slice_axis(qkv, axis=2, begin=0, end=d)
+        k = nd.slice_axis(qkv, axis=2, begin=d, end=d + kvd)
+        v = nd.slice_axis(qkv, axis=2, begin=d + kvd,
+                          end=d + 2 * kvd)
+        if kv != h:
+            # broadcast each kv group to its query heads for compute
+            # (the cache/params stay at kv groups — the GQA win)
+            rep = h // kv
+            k = nd.repeat(k.reshape(b, l, kv, dh), repeats=rep,
+                          axis=2).reshape(b, l, h * dh)
+            v = nd.repeat(v.reshape(b, l, kv, dh), repeats=rep,
+                          axis=2).reshape(b, l, h * dh)
 
         if self._rope:
             # rotate q/k per head BEFORE any sequence sharding:
@@ -213,14 +235,16 @@ class TransformerBlock(Block):
 
     def __init__(self, d_model, n_heads, mlp_ratio=4, dropout=0.0,
                  seq_parallel=False, moe_experts=0,
-                 moe_capacity_factor=1.25, rope=False, **kwargs):
+                 moe_capacity_factor=1.25, rope=False,
+                 n_kv_heads=None, **kwargs):
         super().__init__(**kwargs)
         self.moe_experts = moe_experts
         with self.name_scope():
             self.ln1 = LayerNorm()
             self.attn = CausalSelfAttention(d_model, n_heads,
                                             seq_parallel=seq_parallel,
-                                            rope=rope)
+                                            rope=rope,
+                                            n_kv_heads=n_kv_heads)
             self.ln2 = LayerNorm()
             if moe_experts:
                 self.moe = MoEFFN(d_model, moe_experts,
@@ -251,7 +275,8 @@ class TransformerLM(Block):
     def __init__(self, vocab_size, d_model=512, n_layers=6,
                  n_heads=8, max_len=1024, mlp_ratio=4, dropout=0.0,
                  seq_parallel=False, moe_experts=0,
-                 moe_capacity_factor=1.25, pos="learned", **kwargs):
+                 moe_capacity_factor=1.25, pos="learned",
+                 n_kv_heads=None, **kwargs):
         super().__init__(**kwargs)
         if pos not in ("learned", "rope"):
             raise ValueError(
@@ -271,7 +296,8 @@ class TransformerLM(Block):
                                  moe_experts=moe_experts,
                                  moe_capacity_factor=
                                  moe_capacity_factor,
-                                 rope=(pos == "rope"))
+                                 rope=(pos == "rope"),
+                                 n_kv_heads=n_kv_heads)
                 for _ in range(n_layers)]
             for i, blk in enumerate(self.blocks):
                 setattr(self, f"block{i}", blk)   # register children
@@ -280,6 +306,7 @@ class TransformerLM(Block):
                               use_bias=False)
         self.n_layers = n_layers
         self.n_heads = n_heads
+        self.n_kv_heads = n_kv_heads or n_heads
 
     def forward(self, tokens):
         """Logits (B, L, V); with ``moe_experts`` the return is
@@ -410,6 +437,9 @@ class TransformerLM(Block):
 
         d, h = self._d, self.n_heads
         dh = d // h
+        kv = self.n_kv_heads
+        rep = h // kv
+        kvd = kv * dh
         total = p + max_new
         scale = math.sqrt(d)
         use_rope = self._pos_kind == "rope"
@@ -475,24 +505,30 @@ class TransformerLM(Block):
             for lw, cf in zip(wts["layers"], cfs):
                 xa = ln(x, lw["ln1"])
                 qkv = xa @ lw["qkv"][0].T + lw["qkv"][1]
-                q, k, v = jnp.split(qkv, 3, axis=-1)
-                q = q.reshape(b, p, h, dh)
-                k = k.reshape(b, p, h, dh)
+                q = qkv[..., :d].reshape(b, p, h, dh)
+                k = qkv[..., d:d + kvd].reshape(b, p, kv, dh)
+                v = qkv[..., d + kvd:].reshape(b, p, kv, dh)
                 if use_rope:
                     q, k = rope_fn(q), rope_fn(k)
                 q = q.transpose(0, 2, 1, 3)
                 k = k.transpose(0, 2, 1, 3)
-                v = v.reshape(b, p, h, dh).transpose(0, 2, 1, 3)
-                kc = jnp.zeros((b, h, total, dh),
+                v = v.transpose(0, 2, 1, 3)
+                # GQA: the cache holds only kv head groups
+                kc = jnp.zeros((b, kv, total, dh),
                                jnp.float32).at[:, :, :p].set(k)
-                vc = jnp.zeros((b, h, total, dh),
+                vc = jnp.zeros((b, kv, total, dh),
                                jnp.float32).at[:, :, :p].set(v)
-                s = jnp.einsum("bhqd,bhkd->bhqk", q, k) \
+                # grouped einsum straight against the kv-group
+                # tensors: the h-head repeat is never materialized
+                qg = q.reshape(b, kv, rep, p, dh)
+                s = jnp.einsum("bkrqd,bkcd->bkrqc", qg, k) \
                     / math.sqrt(dh)
                 att = jax.nn.softmax(
-                    jnp.where(mask[None, None], s, -1e9), axis=-1)
-                o = jnp.einsum("bhqk,bhkd->bhqd", att, v)
-                o = o.transpose(0, 2, 1, 3).reshape(b, p, d)
+                    jnp.where(mask[None, None, None], s, -1e9),
+                    axis=-1)
+                o = jnp.einsum("bkrqc,bkcd->bkrqd", att, v)
+                o = o.reshape(b, h, p, dh) \
+                    .transpose(0, 2, 1, 3).reshape(b, p, d)
                 x = x + o @ lw["proj"][0].T + lw["proj"][1]
                 xm = ln(x, lw["ln2"])
                 x = x + _ffn(lw, cf, xm.reshape(b * p, d)) \
@@ -520,25 +556,31 @@ class TransformerLM(Block):
                         zip(wts["layers"], cfs), caches):
                     xa = ln(x, lw["ln1"])
                     qkv = xa @ lw["qkv"][0].T + lw["qkv"][1]
-                    q, k, v = jnp.split(qkv, 3, axis=-1)
+                    q = qkv[..., :d]
+                    k = qkv[..., d:d + kvd]
+                    v = qkv[..., d + kvd:]
                     if use_rope:
                         # this token sits at absolute position i
                         q = rope_fn(q.reshape(b, 1, h, dh),
                                     offset=i).reshape(b, h, dh)
-                        k = rope_fn(k.reshape(b, 1, h, dh),
-                                    offset=i).reshape(b, h, dh)
+                        k = rope_fn(k.reshape(b, 1, kv, dh),
+                                    offset=i).reshape(b, kv, dh)
                     else:
                         q = q.reshape(b, h, dh)
+                        k = k.reshape(b, kv, dh)
                     kc = lax.dynamic_update_index_in_dim(
-                        kc, k.reshape(b, h, dh), i, axis=2)
+                        kc, k, i, axis=2)
                     vc = lax.dynamic_update_index_in_dim(
-                        vc, v.reshape(b, h, dh), i, axis=2)
-                    s = jnp.einsum("bhd,bhcd->bhc", q, kc) \
+                        vc, v.reshape(b, kv, dh), i, axis=2)
+                    qg = q.reshape(b, kv, rep, dh)
+                    s = jnp.einsum("bkrd,bkcd->bkrc", qg, kc) \
                         / math.sqrt(dh)
-                    s = jnp.where(jnp.arange(total)[None, None] <= i,
-                                  s, -1e9)
+                    s = jnp.where(
+                        jnp.arange(total)[None, None, None] <= i,
+                        s, -1e9)
                     att = jax.nn.softmax(s, axis=-1)
-                    o = jnp.einsum("bhc,bhcd->bhd", att, vc)
+                    o = jnp.einsum("bkrc,bkcd->bkrd", att, vc) \
+                        .reshape(b, h, dh)
                     x = x + o.reshape(b, d) @ lw["proj"][0].T \
                         + lw["proj"][1]
                     xm = ln(x, lw["ln2"])
@@ -572,7 +614,8 @@ class TransformerLM(Block):
             mlp = 2 * (2 * 2 * d * hid) + 2 * d * e
         else:
             mlp = 2 * 2 * d * hid          # dense up+down
-        per_layer = (2 * d * 3 * d          # qkv
+        kvd = self.n_kv_heads * (d // self.n_heads)
+        per_layer = (2 * d * (d + 2 * kvd)  # qkv (GQA-sized)
                      + 2 * d * d            # proj
                      + 2 * 2 * seq_len * d  # scores + att@v
                      + mlp)

@@ -388,3 +388,46 @@ def test_rope_with_ring_attention_matches_local(tmp_path):
     with use_mesh(make_mesh(dp=2, sp=4)):
         got = net_sp(toks).asnumpy()
     np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_grouped_query_attention():
+    """n_kv_heads < n_heads (GQA): the k/v projections and the decode
+    cache shrink to kv head groups while attention math matches the
+    full decode <-> forward consistency contract; n_kv_heads ==
+    n_heads is exactly MHA."""
+    mx.random.seed(0)
+    net = TransformerLM(64, d_model=32, n_layers=2, n_heads=8,
+                        max_len=64, n_kv_heads=2)
+    net.initialize(mx.initializer.Xavier())
+    # qkv projection rows: d + 2 * kv * dh = 32 + 2*2*4 = 48
+    qkv_w = [p for n, p in net.collect_params().items()
+             if "dense0_weight" in n][0]
+    assert qkv_w.shape[0] == 48, qkv_w.shape
+
+    toks = mx.nd.array(np.random.RandomState(0)
+                       .randint(0, 64, (2, 16)).astype("int32"))
+    out = net.generate(toks, max_new_tokens=4)
+    nxt = net(toks).asnumpy()[:, -1].argmax(-1)
+    assert (out.asnumpy()[:, 16] == nxt).all()
+
+    # trains through the compiled step
+    step = parallel.ShardedTrainStep(
+        net, optimizer="adam",
+        optimizer_params=dict(learning_rate=1e-2),
+        loss_fn=_lm_loss,
+        example_args=[mx.nd.array(np.zeros((2, 16), "int32"))])
+    rs = np.random.RandomState(0)
+    t = jnp.asarray(rs.randint(0, 64, (8, 16)), jnp.int32)
+    y = jnp.asarray(rs.randint(0, 64, (8, 16)), jnp.int32)
+    losses = [float(step(t, y)) for _ in range(12)]
+    assert losses[-1] < losses[0] * 0.9, losses
+
+    import pytest
+    for bad in (3, 0, -2):
+        with pytest.raises(ValueError, match="multiple"):
+            TransformerLM(64, d_model=32, n_heads=8, n_kv_heads=bad)
+
+    # flops accounting shrinks with the kv projections
+    full = TransformerLM(64, d_model=32, n_layers=2, n_heads=8)
+    assert net.train_flops_per_token(16) < \
+        full.train_flops_per_token(16)
