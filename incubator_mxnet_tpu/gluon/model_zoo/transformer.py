@@ -625,12 +625,19 @@ class TransformerLM(Block):
 
 
 def transformer_lm(vocab_size=32000, size="small", **kwargs):
-    """Factory: 'small' (125M-class), 'medium' (350M-class), or pass
-    explicit dims via kwargs."""
+    """Factory: 'small' (125M-class), 'medium' (350M-class),
+    'modern' (the rope + grouped-query configuration today's
+    decoder LMs ship with), or pass explicit dims via kwargs."""
     presets = {
         "small": dict(d_model=768, n_layers=12, n_heads=12),
         "medium": dict(d_model=1024, n_layers=24, n_heads=16),
+        "modern": dict(d_model=768, n_layers=12, n_heads=12,
+                       n_kv_heads=4, pos="rope"),
     }
-    cfg = dict(presets[size]) if size in presets else {}
+    if size not in presets:
+        raise ValueError(
+            f"unknown size {size!r}; presets: {sorted(presets)} "
+            "(pass explicit dims via kwargs with any preset)")
+    cfg = dict(presets[size])
     cfg.update(kwargs)
     return TransformerLM(vocab_size, **cfg)

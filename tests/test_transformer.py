@@ -431,3 +431,17 @@ def test_grouped_query_attention():
     full = TransformerLM(64, d_model=32, n_layers=2, n_heads=8)
     assert net.train_flops_per_token(16) < \
         full.train_flops_per_token(16)
+
+
+def test_factory_modern_preset():
+    """transformer_lm(size='modern'): rope + grouped-query — the
+    configuration current decoder LMs ship with."""
+    net = transformer_lm(128, size="modern", max_len=32, n_layers=2)
+    net.initialize(mx.initializer.Xavier())
+    assert net.n_kv_heads == 4 and net._pos_kind == "rope"
+    out = net(mx.nd.array(np.zeros((1, 8), "int32")))
+    assert out.shape == (1, 8, 128)
+    import pytest
+    with pytest.raises(ValueError, match="unknown size"):
+        transformer_lm(128, size="modem")   # typo must not silently
+    # build a default model
