@@ -39,45 +39,100 @@ __all__ = ["flash_attention"]
 _NEG = -1e30
 
 
-def _reference_attention(q, k, v, causal, scale):
+def _reference_attention(q, k, v, causal, scale, window=0):
     """Plain XLA attention, the numeric oracle + backward path.
-    q/k/v: (BH, L, D)."""
+    q/k/v: (BH, L, D).  window > 0: sliding-window causal — query i
+    attends to keys (i - window, i]."""
     s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
+    lq, lk = q.shape[1], k.shape[1]
+    qp = jnp.arange(lq)[:, None]
+    kp = jnp.arange(lk)[None, :]
     if causal:
-        lq, lk = q.shape[1], k.shape[1]
-        mask = jnp.tril(jnp.ones((lq, lk), bool))
+        mask = qp >= kp
+        if window > 0:
+            mask &= (qp - kp) < window
         s = jnp.where(mask[None], s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bqk,bkd->bqd", p,
                       v.astype(jnp.float32)).astype(q.dtype)
 
 
-def _causal_mask(s, iq, jk, bq, bk):
+def _causal_mask(s, iq, jk, bq, bk, window=0):
     q_pos = iq * bq + lax.broadcasted_iota(jnp.int32, s.shape, 0)
     k_pos = jk * bk + lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    return jnp.where(q_pos >= k_pos, s, _NEG)
+    keep = q_pos >= k_pos
+    if window > 0:
+        keep &= (q_pos - k_pos) < window
+    return jnp.where(keep, s, _NEG)
+
+
+def _block_live(iq, jk, bq, bk, causal, window):
+    """Does the (q-tile iq, k-tile jk) block hold ANY unmasked pair?
+    Dead blocks skip their FLOPs (the grid still steps through)."""
+    if not causal:
+        return True
+    live = jk * bk <= (iq + 1) * bq - 1        # not above diagonal
+    if window > 0:
+        # below the band: newest key in tile >= oldest in-window key
+        live &= (jk + 1) * bk - 1 >= iq * bq - window + 1
+    return live
+
+
+def _band_nj(window, b_res, b_str, n_str):
+    """Inner-grid length for banded (sliding-window) iteration: the
+    resident tile of size b_res sees at most window + b_res - 1
+    streamed positions -> this many b_str-tiles (+1 for alignment),
+    capped at the full count."""
+    return min(n_str, (b_res + window - 2) // b_str + 2)
+
+
+def _band_base_k(iq, bq, bk, window):
+    """First k-tile of q-tile iq's band (k >= iq*bq - window + 1)."""
+    return jnp.maximum((iq * bq - (window - 1)) // bk, 0)
+
+
+def _band_k_index(iq, j, bq, bk, nk, window):
+    """(k-tile, valid) for inner step j of q-tile iq.  Clamped so the
+    DMA index stays in range; `valid` excludes clamp duplicates and
+    tiles past the causal diagonal."""
+    base = _band_base_k(iq, bq, bk, window)
+    last = jnp.minimum(((iq + 1) * bq - 1) // bk, nk - 1)
+    jk = jnp.minimum(base + j, nk - 1)
+    return jk, base + j <= last
+
+
+def _band_q_index(jk, j, bq, bk, nq, window):
+    """(q-tile, valid) for inner step j of k-tile jk (dkv grid)."""
+    base = (jk * bk) // bq
+    last = jnp.minimum(((jk + 1) * bk - 1 + window - 1) // bq,
+                       nq - 1)
+    iq = jnp.minimum(base + j, nq - 1)
+    return iq, base + j <= last
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc,
-                acc_sc, *, bq, bk, nk, causal, scale):
+                acc_sc, *, bq, bk, nk, nj, causal, scale, window):
     """grid = (BH, NQ, NK): one (q-tile, k-tile) block per step; the
     k dimension is innermost, so the online-softmax carry streams
     through the scratch accumulators."""
     from jax.experimental import pallas as pl
 
     iq = pl.program_id(1)
-    jk = pl.program_id(2)
+    j = pl.program_id(2)
+    if window > 0:
+        # banded: the inner grid walks only the in-window k tiles
+        jk, valid = _band_k_index(iq, j, bq, bk, nk, window)
+        live = valid
+    else:
+        jk = j
+        live = _block_live(iq, jk, bq, bk, causal, window)
 
-    @pl.when(jk == 0)
+    @pl.when(j == 0)
     def _init():
         m_sc[...] = jnp.full_like(m_sc, _NEG)
         l_sc[...] = jnp.zeros_like(l_sc)
         acc_sc[...] = jnp.zeros_like(acc_sc)
-
-    # causal: blocks entirely above the diagonal contribute nothing —
-    # skip their FLOPs (the grid still steps through them)
-    live = (jk * bk <= (iq + 1) * bq - 1) if causal else True
 
     @pl.when(live)
     def _step():
@@ -86,7 +141,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc,
         vb = v_ref[0].astype(jnp.float32)
         s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32)
         if causal:
-            s = _causal_mask(s, iq, jk, bq, bk)
+            s = _causal_mask(s, iq, jk, bq, bk, window)
         m = m_sc[...]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -97,7 +152,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc,
         acc_sc[...] = acc_sc[...] * alpha + jnp.dot(
             p, vb, preferred_element_type=jnp.float32)
 
-    @pl.when(jk == nk - 1)
+    @pl.when(j == nj - 1)
     def _finalize():
         l = l_sc[...]
         o_ref[0] = (acc_sc[...] / l).astype(o_ref.dtype)
@@ -106,7 +161,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc,
         lse_ref[0] = m_sc[...][:, 0] + jnp.log(l[:, 0])
 
 
-def _flash_fwd(q, k, v, causal, scale, interpret):
+def _flash_fwd(q, k, v, causal, scale, interpret, window=0):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -115,15 +170,26 @@ def _flash_fwd(q, k, v, causal, scale, interpret):
     bq = min(128, lq)
     bk = min(128, lk)
     nk = lk // bk
+    # banded (window > 0): the inner grid covers ONLY in-window k
+    # tiles — dead tiles are neither stepped nor DMA'd, so compute
+    # AND HBM traffic are O(L * window)
+    nj = _band_nj(window, bq, bk, nk) if window > 0 else nk
+    if window > 0:
+        def kmap(b, i, j):
+            return (b, _band_k_index(i, j, bq, bk, nk, window)[0], 0)
+    else:
+        def kmap(b, i, j):
+            return (b, j, 0)
     kernel = functools.partial(_fwd_kernel, bq=bq, bk=bk, nk=nk,
-                               causal=causal, scale=scale)
+                               nj=nj, causal=causal, scale=scale,
+                               window=window)
     o, lse = pl.pallas_call(
         kernel,
-        grid=(bh, lq // bq, nk),
+        grid=(bh, lq // bq, nj),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), kmap),
+            pl.BlockSpec((1, bk, d), kmap),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
@@ -144,19 +210,23 @@ def _flash_fwd(q, k, v, causal, scale, interpret):
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
-               dq_ref, dq_sc, *, bq, bk, nk, causal, scale):
+               dq_ref, dq_sc, *, bq, bk, nk, nj, causal, scale,
+               window):
     """grid = (BH, NQ, NK): k/v stream past a resident q tile; dq
     accumulates in scratch."""
     from jax.experimental import pallas as pl
 
     iq = pl.program_id(1)
-    jk = pl.program_id(2)
+    j = pl.program_id(2)
+    if window > 0:
+        jk, live = _band_k_index(iq, j, bq, bk, nk, window)
+    else:
+        jk = j
+        live = _block_live(iq, jk, bq, bk, causal, window)
 
-    @pl.when(jk == 0)
+    @pl.when(j == 0)
     def _init():
         dq_sc[...] = jnp.zeros_like(dq_sc)
-
-    live = (jk * bk <= (iq + 1) * bq - 1) if causal else True
 
     @pl.when(live)
     def _step():
@@ -169,36 +239,37 @@ def _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         s = jnp.dot(q, kb.T,
                     preferred_element_type=jnp.float32) * scale
         if causal:
-            s = _causal_mask(s, iq, jk, bq, bk)
+            s = _causal_mask(s, iq, jk, bq, bk, window)
         p = jnp.exp(s - lse)
         dp = jnp.dot(g, vb.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
         dq_sc[...] = dq_sc[...] + jnp.dot(
             ds, kb, preferred_element_type=jnp.float32)
 
-    @pl.when(jk == nk - 1)
+    @pl.when(j == nj - 1)
     def _finalize():
         dq_ref[0] = dq_sc[...].astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_sc, dv_sc, *, bq, bk, nq, causal,
-                scale):
+                dk_ref, dv_ref, dk_sc, dv_sc, *, bq, bk, nq, nj,
+                causal, scale, window):
     """grid = (BH, NK, NQ): q/g/lse/delta stream past a resident k/v
     tile; dk/dv accumulate in scratch."""
     from jax.experimental import pallas as pl
 
     jk = pl.program_id(1)
-    iq = pl.program_id(2)
+    j = pl.program_id(2)
+    if window > 0:
+        iq, live = _band_q_index(jk, j, bq, bk, nq, window)
+    else:
+        iq = j
+        live = _block_live(iq, jk, bq, bk, causal, window)
 
-    @pl.when(iq == 0)
+    @pl.when(j == 0)
     def _init():
         dk_sc[...] = jnp.zeros_like(dk_sc)
         dv_sc[...] = jnp.zeros_like(dv_sc)
-
-    # causal: q tiles strictly above this k tile's diagonal see none
-    # of it
-    live = ((iq + 1) * bq - 1 >= jk * bk) if causal else True
 
     @pl.when(live)
     def _step():
@@ -211,7 +282,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         s = jnp.dot(qb, kb.T,
                     preferred_element_type=jnp.float32) * scale
         if causal:
-            s = _causal_mask(s, iq, jk, bq, bk)
+            s = _causal_mask(s, iq, jk, bq, bk, window)
         p = jnp.exp(s - lse)
         dv_sc[...] = dv_sc[...] + jnp.dot(
             p.T, gb, preferred_element_type=jnp.float32)
@@ -220,13 +291,14 @@ def _dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         dk_sc[...] = dk_sc[...] + jnp.dot(
             ds.T, qb, preferred_element_type=jnp.float32)
 
-    @pl.when(iq == nq - 1)
+    @pl.when(j == nj - 1)
     def _finalize():
         dk_ref[0] = dk_sc[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_sc[...].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, o, lse, g, causal, scale, interpret):
+def _flash_bwd(q, k, v, o, lse, g, causal, scale, interpret,
+               window=0):
     """Tiled backward: rebuilds each P tile from (q, k, lse) — no
     L x L tensor in HBM on the gradient path either (the FlashAttention
     backward recipe: delta = rowsum(g * o), dS = P*(dP - delta))."""
@@ -239,14 +311,38 @@ def _flash_bwd(q, k, v, o, lse, g, causal, scale, interpret):
     bk = min(128, lk)
     delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)                           # (BH, LQ)
+    nk = lk // bk
+    nq = lq // bq
+    nj_k = _band_nj(window, bq, bk, nk) if window > 0 else nk
+    nj_q = _band_nj(window, bk, bq, nq) if window > 0 else nq
+    if window > 0:
+        def kmap(b, i, j):
+            return (b, _band_k_index(i, j, bq, bk, nk, window)[0], 0)
+
+        def qmap(b, jk, j):
+            return (b, _band_q_index(jk, j, bq, bk, nq, window)[0],
+                    0)
+
+        def qmap1(b, jk, j):
+            return (b, _band_q_index(jk, j, bq, bk, nq, window)[0])
+    else:
+        def kmap(b, i, j):
+            return (b, j, 0)
+
+        def qmap(b, jk, j):
+            return (b, j, 0)
+
+        def qmap1(b, jk, j):
+            return (b, j)
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, bq=bq, bk=bk, nk=lk // bk,
-                          causal=causal, scale=scale),
-        grid=(bh, lq // bq, lk // bk),
+        functools.partial(_dq_kernel, bq=bq, bk=bk, nk=nk,
+                          nj=nj_k, causal=causal, scale=scale,
+                          window=window),
+        grid=(bh, nq, nj_k),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), kmap),
+            pl.BlockSpec((1, bk, d), kmap),
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
             pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
@@ -258,16 +354,17 @@ def _flash_bwd(q, k, v, o, lse, g, causal, scale, interpret):
         interpret=interpret,
     )(q, k, v, g, lse, delta)
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, bq=bq, bk=bk, nq=lq // bq,
-                          causal=causal, scale=scale),
-        grid=(bh, lk // bk, lq // bq),
+        functools.partial(_dkv_kernel, bq=bq, bk=bk, nq=nq,
+                          nj=nj_q, causal=causal, scale=scale,
+                          window=window),
+        grid=(bh, nk, nj_q),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, j, i: (b, i)),
-            pl.BlockSpec((1, bq), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, bq, d), qmap),
+            pl.BlockSpec((1, bk, d), lambda b, jk, j: (b, jk, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, jk, j: (b, jk, 0)),
+            pl.BlockSpec((1, bq, d), qmap),
+            pl.BlockSpec((1, bq), qmap1),
+            pl.BlockSpec((1, bq), qmap1),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
@@ -296,19 +393,20 @@ def _supported(q, k):
             and lk % min(128, lk) == 0)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash(q, k, v, causal, scale, interpret):
-    return _flash_fwd(q, k, v, causal, scale, interpret)[0]
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, scale, interpret, window):
+    return _flash_fwd(q, k, v, causal, scale, interpret, window)[0]
 
 
-def _flash_vjp_fwd(q, k, v, causal, scale, interpret):
-    o, lse = _flash_fwd(q, k, v, causal, scale, interpret)
+def _flash_vjp_fwd(q, k, v, causal, scale, interpret, window):
+    o, lse = _flash_fwd(q, k, v, causal, scale, interpret, window)
     return o, (q, k, v, o, lse)
 
 
-def _flash_vjp_bwd(causal, scale, interpret, res, g):
+def _flash_vjp_bwd(causal, scale, interpret, window, res, g):
     q, k, v, o, lse = res
-    return _flash_bwd(q, k, v, o, lse, g, causal, scale, interpret)
+    return _flash_bwd(q, k, v, o, lse, g, causal, scale, interpret,
+                      window)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -316,19 +414,36 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 @defop("_flash_attention")
 def flash_attention(q, k, v, causal=True, scale=None,
-                    interpret=None):
+                    interpret=None, window=0):
     """Tiled online-softmax attention.  q/k/v: (BH, L, D).
 
     ``interpret`` defaults to True off-TPU (Pallas interpreter) and
     False on TPU (compiled Mosaic kernel).  Falls back to the XLA
     reference implementation for shapes the tiling cannot cover.
+
+    ``window > 0`` (requires ``causal``): sliding-window attention —
+    query i sees keys (i - window, i].  Blocks entirely outside the
+    band skip their FLOPs, so compute is O(L * window) instead of
+    O(L^2 / 2): the long-context local-attention regime (Mistral-
+    style) on the same streaming kernels.
     """
     causal = bool(causal)
+    window = int(window)
+    if window < 0:
+        raise ValueError(f"window must be >= 0, got {window}")
+    if window and not causal:
+        raise ValueError("window > 0 requires causal=True")
+    if window and q.shape[1] != k.shape[1]:
+        raise ValueError(
+            "window > 0 requires self-attention shapes (lq == lk); "
+            f"got lq={q.shape[1]}, lk={k.shape[1]} — a query past "
+            "the key horizon would have an empty key set")
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     scale = float(scale)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     if not _supported(q, k):
-        return _reference_attention(q, k, v, causal, scale)
-    return _flash(q, k, v, causal, scale, bool(interpret))
+        return _reference_attention(q, k, v, causal, scale,
+                                    window=window)
+    return _flash(q, k, v, causal, scale, bool(interpret), window)
