@@ -37,13 +37,23 @@ class CausalSelfAttention(Block):
     """
 
     def __init__(self, d_model, n_heads, seq_parallel=False,
-                 rope=False, n_kv_heads=None, **kwargs):
+                 rope=False, n_kv_heads=None, attn_window=0,
+                 **kwargs):
         super().__init__(**kwargs)
         assert d_model % n_heads == 0
         if seq_parallel not in (False, True, "ring", "ulysses"):
             raise ValueError(
                 "seq_parallel must be False/True/'ring'/'ulysses', "
                 f"got {seq_parallel!r}")
+        if attn_window < 0:
+            raise ValueError(
+                f"attn_window must be >= 0, got {attn_window}")
+        if attn_window and seq_parallel:
+            raise ValueError(
+                "attn_window with seq_parallel is not supported — "
+                "windowed long-context runs single-shard on the "
+                "banded flash kernels (O(L*window) already)")
+        self._window = int(attn_window)
         kv = n_kv_heads if n_kv_heads is not None else n_heads
         if kv <= 0 or n_heads % kv:
             raise ValueError(
@@ -155,12 +165,18 @@ class CausalSelfAttention(Block):
             # Pallas online-softmax kernel (ops/flash.py): no L x L
             # score tensor in HBM; registry op, so the tape and the
             # compiled paths both differentiate it
-            out = nd._internal._flash_attention(q, k, v, causal=True)
+            out = nd._internal._flash_attention(
+                q, k, v, causal=True, window=self._window)
         else:
             scores = nd.batch_dot(q, k, transpose_b=True) \
                 / math.sqrt(dh)
-            mask = nd.array(np.triu(
-                np.full((l, l), -1e9, np.float32), k=1))
+            diff = np.subtract.outer(np.arange(l), np.arange(l))
+            banned = diff < 0      # future
+            if self._window:
+                # sliding window: query i sees (i - window, i]
+                banned |= diff >= self._window
+            mask = nd.array(
+                np.where(banned, -1e9, 0.0).astype(np.float32))
             scores = nd.broadcast_add(scores, mask.expand_dims(0))
             att = nd.softmax(scores, axis=-1)
             out = nd.batch_dot(att, v)             # (B*H, L, Dh)
@@ -236,7 +252,7 @@ class TransformerBlock(Block):
     def __init__(self, d_model, n_heads, mlp_ratio=4, dropout=0.0,
                  seq_parallel=False, moe_experts=0,
                  moe_capacity_factor=1.25, rope=False,
-                 n_kv_heads=None, **kwargs):
+                 n_kv_heads=None, attn_window=0, **kwargs):
         super().__init__(**kwargs)
         self.moe_experts = moe_experts
         with self.name_scope():
@@ -244,7 +260,8 @@ class TransformerBlock(Block):
             self.attn = CausalSelfAttention(d_model, n_heads,
                                             seq_parallel=seq_parallel,
                                             rope=rope,
-                                            n_kv_heads=n_kv_heads)
+                                            n_kv_heads=n_kv_heads,
+                                            attn_window=attn_window)
             self.ln2 = LayerNorm()
             if moe_experts:
                 self.moe = MoEFFN(d_model, moe_experts,
@@ -276,7 +293,7 @@ class TransformerLM(Block):
                  n_heads=8, max_len=1024, mlp_ratio=4, dropout=0.0,
                  seq_parallel=False, moe_experts=0,
                  moe_capacity_factor=1.25, pos="learned",
-                 n_kv_heads=None, **kwargs):
+                 n_kv_heads=None, attn_window=0, **kwargs):
         super().__init__(**kwargs)
         if pos not in ("learned", "rope"):
             raise ValueError(
@@ -297,7 +314,8 @@ class TransformerLM(Block):
                                  moe_capacity_factor=
                                  moe_capacity_factor,
                                  rope=(pos == "rope"),
-                                 n_kv_heads=n_kv_heads)
+                                 n_kv_heads=n_kv_heads,
+                                 attn_window=attn_window)
                 for _ in range(n_layers)]
             for i, blk in enumerate(self.blocks):
                 setattr(self, f"block{i}", blk)   # register children
@@ -307,6 +325,7 @@ class TransformerLM(Block):
         self.n_layers = n_layers
         self.n_heads = n_heads
         self.n_kv_heads = n_kv_heads or n_heads
+        self.attn_window = int(attn_window)
 
     def forward(self, tokens):
         """Logits (B, L, V); with ``moe_experts`` the return is
@@ -443,6 +462,7 @@ class TransformerLM(Block):
         total = p + max_new
         scale = math.sqrt(d)
         use_rope = self._pos_kind == "rope"
+        window = self.attn_window
         from ...ops.matrix import rope_fn
 
         def ln(x, gb):
@@ -500,7 +520,11 @@ class TransformerLM(Block):
             x = wts["embed"][prompt] * scale       # (B, P, D)
             if not use_rope:
                 x = x + wts["pos"][jnp.arange(p)]
-            mask = jnp.tril(jnp.ones((p, p), bool))
+            diff = jnp.arange(p)[:, None] - jnp.arange(p)[None, :]
+            mask = diff >= 0
+            if window:
+                # decode must mask exactly like training
+                mask &= diff < window
             caches = []
             for lw, cf in zip(wts["layers"], cfs):
                 xa = ln(x, lw["ln1"])
@@ -575,9 +599,11 @@ class TransformerLM(Block):
                     qg = q.reshape(b, kv, rep, dh)
                     s = jnp.einsum("bkrd,bkcd->bkrc", qg, kc) \
                         / math.sqrt(dh)
-                    s = jnp.where(
-                        jnp.arange(total)[None, None, None] <= i,
-                        s, -1e9)
+                    cpos = jnp.arange(total)[None, None, None]
+                    keep = cpos <= i
+                    if window:
+                        keep &= cpos > i - window
+                    s = jnp.where(keep, s, -1e9)
                     att = jax.nn.softmax(s, axis=-1)
                     o = jnp.einsum("bkrc,bkcd->bkrd", att, vc) \
                         .reshape(b, h, dh)
@@ -615,9 +641,11 @@ class TransformerLM(Block):
         else:
             mlp = 2 * 2 * d * hid          # dense up+down
         kvd = self.n_kv_heads * (d // self.n_heads)
+        att_span = min(seq_len, self.attn_window) \
+            if self.attn_window else seq_len
         per_layer = (2 * d * (d + 2 * kvd)  # qkv (GQA-sized)
                      + 2 * d * d            # proj
-                     + 2 * 2 * seq_len * d  # scores + att@v
+                     + 2 * 2 * att_span * d  # scores + att@v (banded)
                      + mlp)
         vocab = self.head._units
         fwd = self.n_layers * per_layer + 2 * d * vocab

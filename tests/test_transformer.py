@@ -445,3 +445,63 @@ def test_factory_modern_preset():
     with pytest.raises(ValueError, match="unknown size"):
         transformer_lm(128, size="modem")   # typo must not silently
     # build a default model
+
+
+def test_attn_window_model():
+    """TransformerLM(attn_window=N): sliding-window attention — the
+    flash (banded-kernel) and exact masked paths agree on the same
+    weights, and the combination trains."""
+    import os
+
+    mx.random.seed(0)
+    net = TransformerLM(64, d_model=32, n_layers=2, n_heads=4,
+                        max_len=256, attn_window=128, pos="rope")
+    net.initialize(mx.initializer.Xavier())
+    toks = mx.nd.array(np.random.RandomState(0)
+                       .randint(0, 64, (1, 256)).astype("int32"))
+    prev = os.environ.get("MXTPU_FLASH")
+    try:
+        os.environ["MXTPU_FLASH"] = "1"
+        out_flash = net(toks).asnumpy()
+        os.environ["MXTPU_FLASH"] = "0"
+        out_exact = net(toks).asnumpy()
+    finally:
+        if prev is None:
+            os.environ.pop("MXTPU_FLASH", None)
+        else:
+            os.environ["MXTPU_FLASH"] = prev
+    np.testing.assert_allclose(out_flash, out_exact, rtol=2e-4,
+                               atol=2e-4)
+
+    step = parallel.ShardedTrainStep(
+        net, optimizer="adam",
+        optimizer_params=dict(learning_rate=1e-2),
+        loss_fn=_lm_loss,
+        example_args=[mx.nd.array(np.zeros((1, 256), "int32"))])
+    rs = np.random.RandomState(0)
+    t = jnp.asarray(rs.randint(0, 64, (8, 256)), jnp.int32)
+    y = jnp.asarray(rs.randint(0, 64, (8, 256)), jnp.int32)
+    losses = [float(step(t, y)) for _ in range(6)]
+    assert losses[-1] < losses[0], losses
+
+    import pytest
+    with pytest.raises(ValueError, match="seq_parallel"):
+        TransformerLM(64, attn_window=64, seq_parallel=True)
+    with pytest.raises(ValueError, match=">= 0"):
+        TransformerLM(64, attn_window=-64)
+
+    # decode honors the window even when context exceeds it
+    mx.random.seed(1)
+    netw = TransformerLM(64, d_model=32, n_layers=2, n_heads=4,
+                         max_len=300, attn_window=64, pos="rope")
+    netw.initialize(mx.initializer.Xavier())
+    toks2 = mx.nd.array(np.random.RandomState(2)
+                        .randint(0, 64, (2, 200)).astype("int32"))
+    out = netw.generate(toks2, max_new_tokens=4)
+    nxt = netw(toks2).asnumpy()[:, -1].argmax(-1)
+    assert (out.asnumpy()[:, 200] == nxt).all()
+
+    # FLOPs honor the band
+    assert netw.train_flops_per_token(300) < \
+        TransformerLM(64, d_model=32, n_layers=2, n_heads=4,
+                      max_len=300).train_flops_per_token(300)
