@@ -155,6 +155,7 @@ def _bench_transformer(dev, platform):
     B = int(os.environ.get("MXTPU_BENCH_BATCH", "8"))
     L = int(os.environ.get("MXTPU_BENCH_SEQ", "1024"))
     MOE = int(os.environ.get("MXTPU_BENCH_MOE", "0"))
+    WINDOW = int(os.environ.get("MXTPU_BENCH_WINDOW", "0"))
     V, D, LAYERS, HEADS = 32000, 1024, 12, 16
 
     # the flash kernel has only ever been interpret-verified off-TPU;
@@ -166,8 +167,13 @@ def _bench_transformer(dev, platform):
             from incubator_mxnet_tpu.ops.flash import flash_attention
             q = jax.device_put(
                 jnp.ones((2, 256, D // HEADS), jnp.bfloat16), dev)
+            # probe the EXACT kernel variant the bench will run:
+            # the banded (windowed) grid lowers differently from the
+            # full-causal one
             out = flash_attention(q, q, q, causal=True,
-                                  interpret=False)
+                                  interpret=False,
+                                  window=min(WINDOW, 256)
+                                  if WINDOW else 0)
             float(jax.device_get(out.reshape(-1)[:1])[0])
             flash_ok = True
         except Exception as exc:   # Mosaic lowering/compile failure
@@ -182,7 +188,7 @@ def _bench_transformer(dev, platform):
         mx.random.seed(0)
         net = TransformerLM(V, d_model=D, n_layers=LAYERS,
                             n_heads=HEADS, max_len=L,
-                            moe_experts=MOE)
+                            moe_experts=MOE, attn_window=WINDOW)
         net.initialize(mx.initializer.Xavier())
         ex = mx.nd.array(np.zeros((2, L), "int32"))
 
@@ -238,6 +244,7 @@ def _bench_transformer(dev, platform):
     assert np.isfinite(final_loss), final_loss
     print(json.dumps({
         "metric": f"transformer_lm_150m{'_moe%d' % MOE if MOE else ''}"
+                  f"{'_win%d' % WINDOW if WINDOW else ''}"
                   f"_train_tokens_per_sec_batch{B}_seq{L}_1chip",
         "value": round(tok_s, 1),
         "unit": "tokens/sec",

@@ -127,6 +127,11 @@ def main():
                  {"MXTPU_BENCH_MODEL": "transformer",  # flash path
                   "MXTPU_BENCH_BATCH": "2",
                   "MXTPU_BENCH_SEQ": "4096"}, "bench.py"),
+                ("transformer_l4096_w512",  # banded (sliding-window)
+                 {"MXTPU_BENCH_MODEL": "transformer",
+                  "MXTPU_BENCH_BATCH": "2",
+                  "MXTPU_BENCH_SEQ": "4096",
+                  "MXTPU_BENCH_WINDOW": "512"}, "bench.py"),
                 ("resnet50_b128", {"MXTPU_BENCH_BATCH": "128"},
                  "bench.py"),
                 ("pipeline", {"MXTPU_BENCH_MODEL": "pipeline"},
