@@ -38,6 +38,13 @@ __all__ = ["flash_attention"]
 
 _NEG = -1e30
 
+# Mosaic's block-tiling rule wants the last two dims of every block
+# (8k, 128k)-shaped or equal to the array's; per-row residuals (lse,
+# delta) therefore carry a small trailing lane dim instead of being
+# (BH, L) vectors — lane 0 holds the value, the rest are broadcast
+# copies.  8 sublanes * 4 B is noise next to q/k/v.
+_LANES = 8
+
 
 def _reference_attention(q, k, v, causal, scale, window=0):
     """Plain XLA attention, the numeric oracle + backward path.
@@ -158,7 +165,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc,
         o_ref[0] = (acc_sc[...] / l).astype(o_ref.dtype)
         # log-sum-exp residual: what the backward needs to rebuild P
         # tile-by-tile without the L x L score matrix
-        lse_ref[0] = m_sc[...][:, 0] + jnp.log(l[:, 0])
+        lse = m_sc[...][:, 0:1] + jnp.log(l[:, 0:1])   # (BQ, 1)
+        lse_ref[0] = jnp.broadcast_to(lse, (bq, _LANES))
 
 
 def _flash_fwd(q, k, v, causal, scale, interpret, window=0):
@@ -193,11 +201,11 @@ def _flash_fwd(q, k, v, causal, scale, interpret, window=0):
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((bh, lq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, lq, _LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),
@@ -232,8 +240,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
     def _step():
         q = q_ref[0].astype(jnp.float32)              # (BQ, D)
         g = g_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, None]
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0][:, 0:1]                      # (BQ, 1)
+        delta = delta_ref[0][:, 0:1]
         kb = k_ref[0].astype(jnp.float32)
         vb = v_ref[0].astype(jnp.float32)
         s = jnp.dot(q, kb.T,
@@ -277,8 +285,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         vb = v_ref[0].astype(jnp.float32)
         qb = q_ref[0].astype(jnp.float32)             # (BQ, D)
         gb = g_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, None]
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0][:, 0:1]                      # (BQ, 1)
+        delta = delta_ref[0][:, 0:1]
         s = jnp.dot(qb, kb.T,
                     preferred_element_type=jnp.float32) * scale
         if causal:
@@ -309,8 +317,10 @@ def _flash_bwd(q, k, v, o, lse, g, causal, scale, interpret,
     lk = k.shape[1]
     bq = min(128, lq)
     bk = min(128, lk)
-    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1)                           # (BH, LQ)
+    # (BH, LQ, _LANES): lane-padded like lse (Mosaic block tiling)
+    delta = jnp.broadcast_to(
+        jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
+                axis=-1, keepdims=True), (bh, lq, _LANES))
     nk = lk // bk
     nq = lq // bq
     nj_k = _band_nj(window, bq, bk, nk) if window > 0 else nk
@@ -322,18 +332,12 @@ def _flash_bwd(q, k, v, o, lse, g, causal, scale, interpret,
         def qmap(b, jk, j):
             return (b, _band_q_index(jk, j, bq, bk, nq, window)[0],
                     0)
-
-        def qmap1(b, jk, j):
-            return (b, _band_q_index(jk, j, bq, bk, nq, window)[0])
     else:
         def kmap(b, i, j):
             return (b, j, 0)
 
         def qmap(b, jk, j):
             return (b, j, 0)
-
-        def qmap1(b, jk, j):
-            return (b, j)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, bq=bq, bk=bk, nk=nk,
                           nj=nj_k, causal=causal, scale=scale,
@@ -344,8 +348,8 @@ def _flash_bwd(q, k, v, o, lse, g, causal, scale, interpret,
             pl.BlockSpec((1, bk, d), kmap),
             pl.BlockSpec((1, bk, d), kmap),
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, bq, d),
                                lambda b, i, j: (b, i, 0)),
@@ -363,8 +367,8 @@ def _flash_bwd(q, k, v, o, lse, g, causal, scale, interpret,
             pl.BlockSpec((1, bk, d), lambda b, jk, j: (b, jk, 0)),
             pl.BlockSpec((1, bk, d), lambda b, jk, j: (b, jk, 0)),
             pl.BlockSpec((1, bq, d), qmap),
-            pl.BlockSpec((1, bq), qmap1),
-            pl.BlockSpec((1, bq), qmap1),
+            pl.BlockSpec((1, bq, _LANES), qmap),
+            pl.BlockSpec((1, bq, _LANES), qmap),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),

@@ -282,6 +282,35 @@ class ShardedTrainStep:
 
     step = __call__
 
+    def memory_analysis(self, x, y):
+        """XLA's compiled-buffer accounting for this train step (the
+        reference's memonger/`mirror` cost question: how much HBM
+        does one step hold?).  Returns the backend's MemoryAnalysis
+        (``.temp_size_in_bytes`` = activations + scratch) or None
+        when the backend doesn't report one.  Lowers from abstract
+        shapes against the step's real shardings; no data moves and
+        nothing executes (note: this AOT compile does not seed the
+        jit cache — the first real step() still traces)."""
+        x, y = _raw(x), _raw(y)
+        if self._step is None:
+            self._step = self._build(x, y)
+        # avals only: lowering never touches values, so don't pay a
+        # host->device copy of a global batch just to ask a question
+        xa = jax.ShapeDtypeStruct(
+            x.shape, x.dtype, sharding=self._input_sharding(x.ndim))
+        ya = jax.ShapeDtypeStruct(
+            y.shape, y.dtype,
+            sharding=self._input_sharding(y.ndim, True))
+        rng = jax.random.PRNGKey(0)   # traced arg; value irrelevant
+        with use_mesh(self.mesh):
+            compiled = self._step.lower(
+                self.params, self.states, self.opt_state,
+                self.step_count, xa, ya, rng).compile()
+        try:
+            return compiled.memory_analysis()
+        except Exception:
+            return None
+
     def evaluate(self, x, rng=None):
         """Compiled inference forward on a global batch."""
         x = _raw(x)
