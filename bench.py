@@ -399,6 +399,12 @@ def main():
     import jax
     import jax.numpy as jnp
 
+    # persistent executable cache: a timed-out cold compile over the
+    # tunnel still seeds the disk cache for the next attempt
+    from incubator_mxnet_tpu.utils.platform import \
+        enable_compile_cache
+    enable_compile_cache()
+
     dev = _probe_accelerator()
     cpu = jax.devices("cpu")[0]
     platform = dev.platform if dev is not None else "cpu"

@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Adversarial examples by FGSM (ref role:
+example/adversary/adversary_generation.ipynb — train a classifier,
+then perturb inputs along the *input* gradient sign to flip its
+predictions).
+
+Exercises the one autograd surface no other example touches:
+gradients with respect to DATA (``x.attach_grad()`` inside
+``autograd.record``), not parameters.
+
+--quick is the CI gate: clean accuracy > 0.9, and an eps-ball FGSM
+perturbation (invisible at eps=0.15 against unit-range inputs) must
+cut accuracy by at least half — while the same-magnitude random
+perturbation must not.
+"""
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="FGSM adversary")
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--epochs", type=int, default=8)
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--eps", type=float, default=0.15)
+    p.add_argument("--quick", action="store_true")
+    return p.parse_args(argv)
+
+
+def synthetic_digits(n, rs):
+    x = rs.rand(n, 784).astype(np.float32) * 0.3
+    y = rs.randint(0, 10, n)
+    img = x.reshape(n, 28, 28)
+    for i in range(n):
+        c = y[i]
+        if c < 5:
+            img[i, 4 + 4 * c:7 + 4 * c, 4:24] += 0.7
+        else:
+            img[i, 4:24, 4 + 4 * (c - 5):7 + 4 * (c - 5)] += 0.7
+    return x, y.astype(np.float32)
+
+
+def main(argv=None):
+    from incubator_mxnet_tpu.utils.platform import maybe_force_cpu
+    maybe_force_cpu()
+    args = parse_args(argv)
+    if args.quick:
+        args.epochs = 6
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, gluon, nd
+    from incubator_mxnet_tpu.gluon import nn
+
+    mx.random.seed(0)
+    rs = np.random.RandomState(0)
+    xtr, ytr = synthetic_digits(2048, rs)
+    xva, yva = synthetic_digits(512, np.random.RandomState(1))
+
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(128, activation="relu"),
+                nn.Dense(64, activation="relu"),
+                nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr,
+                             "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    for ep in range(args.epochs):
+        perm = rs.permutation(len(xtr))
+        for i in range(0, len(xtr) - args.batch_size + 1,
+                       args.batch_size):
+            xb = nd.array(xtr[perm[i:i + args.batch_size]])
+            yb = nd.array(ytr[perm[i:i + args.batch_size]])
+            with autograd.record():
+                loss = loss_fn(net(xb), yb).mean()
+            loss.backward()
+            trainer.step(args.batch_size)
+
+    def accuracy(x):
+        preds = net(nd.array(x)).asnumpy().argmax(1)
+        return float((preds == yva).mean())
+
+    clean_acc = accuracy(xva)
+
+    # --- FGSM: d(loss)/d(input), not d(loss)/d(params) -------------
+    xadv = nd.array(xva)
+    xadv.attach_grad()
+    yv = nd.array(yva)
+    with autograd.record():
+        loss = loss_fn(net(xadv), yv).sum()
+    loss.backward()
+    sign = np.sign(xadv.grad.asnumpy())
+    x_fgsm = np.clip(xva + args.eps * sign, 0, 1)
+    fgsm_acc = accuracy(x_fgsm)
+
+    # control: random same-magnitude perturbation barely hurts
+    rnd = np.sign(np.random.RandomState(2)
+                  .randn(*xva.shape)).astype(np.float32)
+    rand_acc = accuracy(np.clip(xva + args.eps * rnd, 0, 1))
+
+    summary = dict(eps=args.eps, clean_acc=clean_acc,
+                   fgsm_acc=fgsm_acc, random_acc=rand_acc)
+    print(json.dumps(summary))
+    if args.quick:
+        assert clean_acc > 0.9, summary
+        assert fgsm_acc < 0.5 * clean_acc, summary
+        assert rand_acc > 0.8 * clean_acc, summary
+    return summary
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Multi-task learning: one trunk, two supervised heads trained
+jointly (ref role: example/multi-task/example_multi_task.py — a
+shared LeNet trunk with two SoftmaxOutputs, summed gradients).
+
+Symbolic path: the two heads are Grouped into one Symbol, bound once,
+and both losses backprop through the shared trunk in a single
+fwd/bwd — the reference's `mx.sym.Group([sm1, sm2])` pattern.
+
+Task A: digit class (10-way) of a synthetic MNIST-style image.
+Task B: parity of that digit (2-way).  --quick gates both heads.
+"""
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="multi-task symbolic")
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--epochs", type=int, default=10)
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--quick", action="store_true")
+    return p.parse_args(argv)
+
+
+def synthetic_digits(n, rs):
+    x = rs.rand(n, 1, 28, 28).astype(np.float32) * 0.3
+    y = rs.randint(0, 10, n)
+    for i in range(n):
+        c = y[i]
+        if c < 5:
+            x[i, 0, 4 + 4 * c:7 + 4 * c, 4:24] += 0.7
+        else:
+            x[i, 0, 4:24, 4 + 4 * (c - 5):7 + 4 * (c - 5)] += 0.7
+    return x.reshape(n, 784), y.astype(np.float32)
+
+
+def build(mx):
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, num_hidden=128, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=64, name="fc2")
+    h = mx.sym.Activation(h, act_type="relu")
+    digit = mx.sym.FullyConnected(h, num_hidden=10, name="digit_fc")
+    digit = mx.sym.SoftmaxOutput(digit, name="digit")
+    parity = mx.sym.FullyConnected(h, num_hidden=2, name="parity_fc")
+    parity = mx.sym.SoftmaxOutput(parity, name="parity")
+    return mx.sym.Group([digit, parity])
+
+
+class MultiAccuracy:
+    """Per-head accuracy over a Group's outputs (the reference
+    example's custom Multi_Accuracy metric)."""
+
+    def __init__(self):
+        self.hits = [0, 0]
+        self.n = 0
+
+    def update(self, labels, preds):
+        for i, (l, p) in enumerate(zip(labels, preds)):
+            self.hits[i] += int((p.argmax(1) == l).sum())
+        self.n += len(labels[0])
+
+    def get(self):
+        return [h / max(self.n, 1) for h in self.hits]
+
+
+def main(argv=None):
+    from incubator_mxnet_tpu.utils.platform import maybe_force_cpu
+    maybe_force_cpu()
+    args = parse_args(argv)
+    if args.quick:
+        args.epochs = 8
+
+    import incubator_mxnet_tpu as mx
+
+    mx.random.seed(0)
+    rs = np.random.RandomState(0)
+    xtr, ytr = synthetic_digits(2048, rs)
+    xva, yva = synthetic_digits(512, np.random.RandomState(1))
+
+    sym = build(mx)
+    mod = mx.mod.Module(sym, data_names=["data"],
+                        label_names=["digit_label",
+                                     "parity_label"])
+    train_iter = mx.io.NDArrayIter(
+        {"data": xtr},
+        {"digit_label": ytr, "parity_label": ytr % 2},
+        batch_size=args.batch_size, shuffle=True)
+    mod.bind(data_shapes=train_iter.provide_data,
+             label_shapes=train_iter.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd", optimizer_params=dict(
+        learning_rate=args.lr, momentum=0.9))
+
+    for ep in range(args.epochs):
+        train_iter.reset()
+        for batch in train_iter:
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+        print(f"epoch {ep} done", flush=True)
+
+    # validation
+    val_iter = mx.io.NDArrayIter(
+        {"data": xva},
+        {"digit_label": yva, "parity_label": yva % 2},
+        batch_size=args.batch_size)
+    acc = MultiAccuracy()
+    for batch in val_iter:
+        mod.forward(batch, is_train=False)
+        preds = [o.asnumpy() for o in mod.get_outputs()]
+        labels = [l.asnumpy() for l in batch.label]
+        acc.update(labels, preds)
+    digit_acc, parity_acc = acc.get()
+
+    summary = dict(digit_acc=float(digit_acc),
+                   parity_acc=float(parity_acc))
+    print(json.dumps(summary))
+    if args.quick:
+        assert digit_acc > 0.9, summary
+        assert parity_acc > 0.9, summary
+    return summary
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Noise-contrastive estimation for large-softmax training (ref
+role: example/nce-loss/{nce.py,wordvec.py} — train word embeddings
+against k sampled negatives instead of a full-vocab softmax).
+
+Gluon path: a skip-gram-style model over a synthetic corpus with
+strong co-occurrence structure.  For each (center, target) pair we
+draw k noise words from the unigram distribution and optimize the
+NCE binary objective: sigma(s(center,target)) -> 1,
+sigma(s(center,noise)) -> 0, with s the embedding dot product.
+
+--quick is the CI gate: NCE-trained scores must rank the true
+co-occurring word above all sampled noise words far more often than
+chance, and loss must halve.
+"""
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="NCE word embeddings")
+    p.add_argument("--vocab", type=int, default=200)
+    p.add_argument("--dim", type=int, default=32)
+    p.add_argument("--negatives", type=int, default=8)
+    p.add_argument("--batch-size", type=int, default=256)
+    p.add_argument("--steps", type=int, default=300)
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--quick", action="store_true")
+    return p.parse_args(argv)
+
+
+def make_pairs(rs, n, vocab):
+    """Co-occurrence rule: word w pairs with (w*7+3)%vocab mostly,
+    sometimes (w*7+4)%vocab — learnable, non-trivial."""
+    c = rs.randint(0, vocab, n)
+    t = (c * 7 + np.where(rs.rand(n) < 0.8, 3, 4)) % vocab
+    return c.astype(np.int32), t.astype(np.int32)
+
+
+def main(argv=None):
+    from incubator_mxnet_tpu.utils.platform import maybe_force_cpu
+    maybe_force_cpu()
+    args = parse_args(argv)
+    if args.quick:
+        args.steps = 250
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, gluon, nd
+    from incubator_mxnet_tpu.gluon import nn
+
+    class NCEModel(gluon.Block):
+        def __init__(self, vocab, dim, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.center = nn.Embedding(vocab, dim)
+                self.context = nn.Embedding(vocab, dim)
+
+        def scores(self, c, w):
+            """s(c, w) per pair; w: (N, K) candidate ids."""
+            e_c = self.center(c)            # (N, D)
+            e_w = self.context(w)           # (N, K, D)
+            return (e_w * e_c.reshape((-1, 1, args.dim))).sum(
+                axis=2)                     # (N, K)
+
+    mx.random.seed(0)
+    rs = np.random.RandomState(0)
+
+    net = NCEModel(args.vocab, args.dim)
+    net.initialize(mx.init.Normal(0.1))
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    bce = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+
+    first = last = None
+    for it in range(args.steps):
+        c, t = make_pairs(rs, args.batch_size, args.vocab)
+        noise = rs.randint(
+            0, args.vocab,
+            (args.batch_size, args.negatives)).astype(np.int32)
+        cand = np.concatenate([t[:, None], noise], axis=1)
+        lbl = np.zeros_like(cand, np.float32)
+        lbl[:, 0] = 1.0
+        cb, wb, yb = nd.array(c), nd.array(cand), nd.array(lbl)
+        with autograd.record():
+            s = net.scores(cb, wb)
+            loss = bce(s, yb).mean()
+        loss.backward()
+        trainer.step(args.batch_size)
+        l = float(loss.asnumpy())
+        if first is None:
+            first = l
+        last = l
+        if it % 50 == 0:
+            print(f"step {it}: nce_loss={l:.4f}", flush=True)
+
+    # evaluation: does the true target outrank fresh noise?
+    c, t = make_pairs(np.random.RandomState(1), 512, args.vocab)
+    noise = np.random.RandomState(2).randint(
+        0, args.vocab, (512, args.negatives)).astype(np.int32)
+    cand = np.concatenate([t[:, None], noise], axis=1)
+    s = net.scores(nd.array(c), nd.array(cand)).asnumpy()
+    rank_acc = float((s.argmax(1) == 0).mean())
+    chance = 1.0 / (1 + args.negatives)
+
+    summary = dict(first_loss=first, final_loss=last,
+                   rank_acc=rank_acc, chance=chance)
+    print(json.dumps(summary))
+    if args.quick:
+        assert last < 0.5 * first, (first, last)
+        assert rank_acc > 3 * chance, summary
+    return summary
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,173 @@
+#!/usr/bin/env python
+"""Speech-style CTC sequence recognition (ref role:
+example/speech_recognition/ + example/warpctc/lstm_ocr.py — a
+recurrent acoustic model trained with CTC on unsegmented label
+sequences, greedy best-path decoding).
+
+Data is synthetic "speech" (zero-egress): each utterance is a label
+sequence of 3-6 phonemes; every phoneme emits a variable number
+(2-4) of noisy acoustic frames drawn from that phoneme's template,
+so frame count != label count and alignment is latent — exactly the
+problem CTC solves.
+
+--quick is the CI gate: greedy-decoded label error rate (edit
+distance / length) must fall below 0.15 after training, from ~1.0
+untrained.
+"""
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+N_PHONES = 8          # classes 0..7; CTC blank is the LAST channel
+FRAME_DIM = 16
+MAX_LABEL = 6
+MAX_FRAMES = 26
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="BiLSTM + CTC")
+    p.add_argument("--hidden", type=int, default=48)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--steps", type=int, default=250)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--clip", type=float, default=1.0)
+    p.add_argument("--quick", action="store_true")
+    return p.parse_args(argv)
+
+
+def make_utterances(rs, n, templates):
+    X = np.zeros((n, MAX_FRAMES, FRAME_DIM), np.float32)
+    Y = np.full((n, MAX_LABEL), -1, np.float32)
+    xl = np.zeros(n, np.float32)
+    yl = np.zeros(n, np.float32)
+    for i in range(n):
+        L = rs.randint(3, MAX_LABEL + 1)
+        labels = rs.randint(0, N_PHONES, L)
+        t = 0
+        for ph in labels:
+            for _ in range(rs.randint(2, 5)):
+                if t >= MAX_FRAMES:
+                    break
+                X[i, t] = templates[ph] + \
+                    rs.randn(FRAME_DIM).astype(np.float32) * 0.3
+                t += 1
+        Y[i, :L] = labels
+        xl[i], yl[i] = t, L
+    return X, Y, xl, yl
+
+
+def edit_distance(a, b):
+    dp = np.arange(len(b) + 1)
+    for i, ca in enumerate(a, 1):
+        prev, dp[0] = dp[0], i
+        for j, cb in enumerate(b, 1):
+            prev, dp[j] = dp[j], min(dp[j] + 1, dp[j - 1] + 1,
+                                     prev + (ca != cb))
+    return int(dp[-1])
+
+
+def greedy_decode(logits, length):
+    """Best path: argmax per frame, collapse repeats, drop blanks."""
+    path = logits[:int(length)].argmax(1)
+    out, prev = [], -1
+    for p in path:
+        if p != prev and p != N_PHONES:   # blank = last channel
+            out.append(int(p))
+        prev = p
+    return out
+
+
+def main(argv=None):
+    from incubator_mxnet_tpu.utils.platform import maybe_force_cpu
+    maybe_force_cpu()
+    args = parse_args(argv)
+    if args.quick:
+        args.steps = 200
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, gluon, nd
+    from incubator_mxnet_tpu.gluon import nn, rnn
+
+    class AcousticModel(gluon.Block):
+        def __init__(self, hidden, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.lstm = rnn.LSTM(hidden, num_layers=1,
+                                     bidirectional=True,
+                                     layout="NTC",
+                                     input_size=FRAME_DIM)
+                # +1 output channel: the CTC blank
+                self.proj = nn.Dense(N_PHONES + 1, flatten=False)
+
+        def forward(self, x):
+            h, _ = self.lstm(x, self.lstm.begin_state(x.shape[0]))
+            return self.proj(h)           # (N, T, C+1)
+
+    mx.random.seed(0)
+    rs = np.random.RandomState(0)
+    templates = rs.randn(N_PHONES, FRAME_DIM).astype(np.float32) * 2
+
+    net = AcousticModel(args.hidden)
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    ctc = gluon.loss.CTCLoss(layout="NTC", label_layout="NT")
+
+    def ler(n_eval=64):
+        X, Y, xl, yl = make_utterances(
+            np.random.RandomState(1), n_eval, templates)
+        logits = net(nd.array(X)).asnumpy()
+        errs = tot = 0
+        for i in range(n_eval):
+            hyp = greedy_decode(logits[i], xl[i])
+            ref = [int(c) for c in Y[i][:int(yl[i])]]
+            errs += edit_distance(hyp, ref)
+            tot += len(ref)
+        return errs / tot
+
+    init_ler = ler()
+    first = last = None
+    for it in range(args.steps):
+        X, Y, xl, yl = make_utterances(rs, args.batch_size,
+                                       templates)
+        xb, yb = nd.array(X), nd.array(Y)
+        xlb, ylb = nd.array(xl), nd.array(yl)
+        with autograd.record():
+            logits = net(xb)
+            loss = ctc(logits, yb, xlb, ylb).mean()
+        loss.backward()
+        # CTC gradients spike when an alignment collapses; global
+        # clipping keeps adam from running off (the reference's
+        # speech examples clip the same way)
+        from incubator_mxnet_tpu.gluon import utils as gutils
+        gutils.clip_global_norm(
+            [p.grad() for p in net.collect_params().values()
+             if p.grad_req != "null"], args.clip)
+        trainer.step(args.batch_size)
+        l = float(loss.asnumpy())
+        if first is None:
+            first = l
+        last = l
+        if it % 50 == 0:
+            print(f"step {it}: ctc_loss={l:.3f} "
+                  f"ler={ler(32):.3f}", flush=True)
+
+    final_ler = ler()
+    summary = dict(first_loss=first, final_loss=last,
+                   init_ler=float(init_ler),
+                   final_ler=float(final_ler))
+    print(json.dumps(summary))
+    if args.quick:
+        assert final_ler < 0.15, summary
+        assert last < 0.5 * first, summary
+    return summary
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""Gluon word-level RNN language model (ref role:
+example/gluon/word_language_model/{train,model}.py — Embedding +
+fused LSTM + tied decoder, truncated BPTT with carried hidden state,
+global-norm gradient clipping).
+
+Corpus is synthetic (zero-egress): word sequences from a small
+template grammar with strong bigram structure, so a trained LM's
+perplexity lands far below the uniform-vocabulary floor.
+
+--quick is the CI gate: validation perplexity must drop below 40%
+of the first epoch's and beat the uniform baseline, and the tied
+decoder must really share the embedding weight (one Parameter).
+"""
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+SENTS = ["the cat sat on the mat",
+         "the dog ran in the park",
+         "a bird flew over the tree",
+         "the cat ran after the bird",
+         "a dog sat under the tree"]
+
+
+def corpus(n_tokens, rs):
+    toks = []
+    while len(toks) < n_tokens:
+        toks += SENTS[rs.randint(len(SENTS))].split() + ["<eos>"]
+    vocab = sorted(set(toks))
+    stoi = {w: i for i, w in enumerate(vocab)}
+    return np.array([stoi[t] for t in toks[:n_tokens]],
+                    np.int32), vocab
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="Gluon word LM")
+    p.add_argument("--emsize", type=int, default=64)
+    p.add_argument("--nhid", type=int, default=64)
+    p.add_argument("--nlayers", type=int, default=2)
+    p.add_argument("--bptt", type=int, default=16)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--epochs", type=int, default=6)
+    p.add_argument("--lr", type=float, default=5.0)
+    p.add_argument("--clip", type=float, default=0.25)
+    p.add_argument("--no-tied", action="store_true")
+    p.add_argument("--quick", action="store_true",
+                   help="CI mode: short run + perplexity gate")
+    return p.parse_args(argv)
+
+
+def batchify(data, bsz):
+    nb = len(data) // bsz
+    return data[:nb * bsz].reshape(bsz, nb).T   # (T, N)
+
+
+def main(argv=None):
+    from incubator_mxnet_tpu.utils.platform import maybe_force_cpu
+    maybe_force_cpu()
+    args = parse_args(argv)
+    if args.quick:
+        args.epochs = 4
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, gluon, nd
+    from incubator_mxnet_tpu.gluon import nn, rnn, utils
+
+    class RNNModel(gluon.Block):
+        """Embedding -> LSTM -> (tied) Dense decoder."""
+
+        def __init__(self, vocab, emsize, nhid, nlayers, tied,
+                     **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.encoder = nn.Embedding(vocab, emsize)
+                self.rnn = rnn.LSTM(nhid, num_layers=nlayers,
+                                    layout="TNC",
+                                    input_size=emsize)
+                if tied:
+                    assert nhid == emsize, "tied needs nhid==emsize"
+                    self.decoder = nn.Dense(
+                        vocab, flatten=False, in_units=nhid,
+                        params=self.encoder.params)
+                else:
+                    self.decoder = nn.Dense(vocab, flatten=False,
+                                            in_units=nhid)
+
+        def forward(self, x, state):
+            emb = self.encoder(x)
+            out, state = self.rnn(emb, state)
+            return self.decoder(out), state
+
+        def begin_state(self, batch_size):
+            return self.rnn.begin_state(batch_size=batch_size)
+
+    mx.random.seed(0)
+    rs = np.random.RandomState(0)
+    data, vocab = corpus(4000, rs)
+    val_data, _ = corpus(800, np.random.RandomState(1))
+    V = len(vocab)
+    train = batchify(data, args.batch_size)
+    val = batchify(val_data, args.batch_size)
+
+    tied = not args.no_tied
+    model = RNNModel(V, args.emsize, args.nhid, args.nlayers, tied)
+    model.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(model.collect_params(), "sgd",
+                            {"learning_rate": args.lr})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    if tied:
+        # the gate: decoder weight IS the embedding weight
+        assert model.decoder.weight is model.encoder.weight
+
+    def run_epoch(split, train_mode):
+        total, count = 0.0, 0
+        state = model.begin_state(args.batch_size)
+        for i in range(0, split.shape[0] - 1 - args.bptt,
+                       args.bptt):
+            x = nd.array(split[i:i + args.bptt])
+            y = nd.array(split[i + 1:i + 1 + args.bptt]
+                         .astype(np.float32))
+            state = [s.detach() for s in state]
+            if train_mode:
+                with autograd.record():
+                    out, state = model(x, state)
+                    loss = loss_fn(out.reshape(-1, V),
+                                   y.reshape(-1)).mean()
+                loss.backward()
+                grads = [p.grad() for p in
+                         model.collect_params().values()
+                         if p.grad_req != "null"]
+                utils.clip_global_norm(grads, args.clip)
+                trainer.step(1)
+            else:
+                out, state = model(x, state)
+                loss = loss_fn(out.reshape(-1, V),
+                               y.reshape(-1)).mean()
+            total += float(loss.asnumpy())
+            count += 1
+        return float(np.exp(total / max(count, 1)))
+
+    first_ppl = None
+    val_ppl = None
+    for ep in range(args.epochs):
+        train_ppl = run_epoch(train, True)
+        val_ppl = run_epoch(val, False)
+        if first_ppl is None:
+            first_ppl = val_ppl
+        print(f"epoch {ep}: train_ppl={train_ppl:.2f} "
+              f"val_ppl={val_ppl:.2f}", flush=True)
+
+    summary = dict(vocab=V, tied=tied, uniform_ppl=float(V),
+                   first_ppl=first_ppl, final_ppl=val_ppl)
+    print(json.dumps(summary))
+    if args.quick:
+        assert val_ppl < 0.4 * first_ppl, (first_ppl, val_ppl)
+        assert val_ppl < V, (val_ppl, V)
+    return summary
+
+
+if __name__ == "__main__":
+    main()

@@ -262,6 +262,14 @@ class HybridBlock(Block):
                     for name, p in self.params.items()}
 
     def _strip(self, name):
+        # strip the parameter DICT's prefix, not the block's: a block
+        # built with params=other.params shares the donor dict and
+        # with it the donor's prefix (weight tying, ref: gluon
+        # word_language_model model.py tie_weights) — its param
+        # names carry the donor prefix while self.prefix differs
+        pfx = self.params.prefix
+        if name.startswith(pfx):
+            return name[len(pfx):]
         return name[len(self.prefix):] if \
             name.startswith(self.prefix) else name
 

@@ -25,12 +25,18 @@ NEG = -1e30  # -inf substitute that keeps logaddexp gradients finite
 
 
 def _logaddexp(a, b):
-    m = jnp.maximum(a, b)
-    m_safe = jnp.where(m > NEG / 2, m, 0.0)
-    return jnp.where(
-        m > NEG / 2,
-        m_safe + jnp.log(jnp.exp(a - m_safe) + jnp.exp(b - m_safe)),
-        NEG)
+    # The dead branch must be NaN-free even in its GRADIENT: with
+    # a = b = NEG the untaken branch's vjp is exp(a-m)/(exp(a-m)
+    # + exp(b-m)) = 0/0, and where-grad's 0 * NaN poisons the whole
+    # backward (autograd tape -> adam -> weights).  Clamp the inputs
+    # of the dead branch too, not just the max (double-where trick).
+    ok = jnp.maximum(a, b) > NEG / 2
+    a_safe = jnp.where(ok, a, 0.0)
+    b_safe = jnp.where(ok, b, 0.0)
+    m_safe = jnp.maximum(a_safe, b_safe)
+    out = m_safe + jnp.log(jnp.exp(a_safe - m_safe)
+                           + jnp.exp(b_safe - m_safe))
+    return jnp.where(ok, out, NEG)
 
 
 def _ctc_single(log_probs, labels, T_len, L_len, blank):

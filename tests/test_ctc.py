@@ -122,3 +122,29 @@ def test_gluon_ctc_loss_grad():
     loss.backward()
     g = pred.grad.asnumpy()
     assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_ctc_loss_grad_finite_padded_lengths():
+    """Regression: with per-sample label/data lengths, the extended
+    states past 2*L_len+1 keep alpha == NEG on BOTH logaddexp inputs;
+    the dead branch's vjp is then 0/0 and where-grad turned the whole
+    backward NaN (caught by examples/speech_ctc.py: adam NaN-poisoned
+    the weights after one step while the forward loss looked fine)."""
+    rs = np.random.RandomState(7)
+    T, B, C, L = 26, 8, 9, 6
+    loss_fn = mx.gluon.loss.CTCLoss(layout="NTC", label_layout="NT")
+    pred = nd.array(rs.randn(B, T, C).astype(np.float32))
+    label = np.full((B, L), -1, np.float32)
+    lab_len = rs.randint(3, L + 1, B)
+    for i in range(B):
+        label[i, :lab_len[i]] = rs.randint(0, C - 1, lab_len[i])
+    dat_len = rs.randint(2 * L, T + 1, B).astype(np.float32)
+    pred.attach_grad()
+    with autograd.record():
+        loss = loss_fn(pred, nd.array(label), nd.array(dat_len),
+                       nd.array(lab_len.astype(np.float32))).mean()
+    loss.backward()
+    g = pred.grad.asnumpy()
+    assert np.isfinite(loss.asnumpy()).all()
+    assert np.isfinite(g).all(), "NaN/inf in CTC backward"
+    assert np.abs(g).sum() > 0

@@ -26,8 +26,10 @@ def main():
         # MXTPU_FORCE_CPU=1 pins the host platform BEFORE first jax
         # use (the sitecustomize-forced axon platform otherwise hangs
         # when the tunnel is down) — same contract as bench/tools
-        from incubator_mxnet_tpu.utils.platform import maybe_force_cpu
+        from incubator_mxnet_tpu.utils.platform import (
+            enable_compile_cache, maybe_force_cpu)
         maybe_force_cpu()
+        enable_compile_cache()
     except Exception:
         pass
     import jax

@@ -113,30 +113,46 @@ def main():
         # overwrite this one's results
         fname = os.path.join(REPO, time.strftime(
             "BENCH_opportunistic_%Y%m%d_%H%M%S.json"))
-        for mode, env, script in [
+        # value-ordered: Pallas gate first (quick, de-risks every
+        # flash claim), then the MFU-bearing transformer rows, then
+        # the headline resnet.  Cold compiles over the tunnel run
+        # tens of minutes, hence the big timeouts — the persistent
+        # compile cache (enable_compile_cache) makes even a
+        # timed-out attempt seed the next one, so a retry of a 124
+        # is cheap.  bandwidth last (already measured this window).
+        for mode, env, script, tmo in [
                 ("flash_compile", {},
-                 "tools/flash_compile_check.py"),
-                ("bandwidth", {}, "tools/bandwidth.py"),
-                ("resnet50", {}, "bench.py"),
+                 "tools/flash_compile_check.py", 2400),
                 ("transformer", {"MXTPU_BENCH_MODEL": "transformer"},
-                 "bench.py"),
+                 "bench.py", 2700),
                 ("transformer_b32",
                  {"MXTPU_BENCH_MODEL": "transformer",
-                  "MXTPU_BENCH_BATCH": "32"}, "bench.py"),
+                  "MXTPU_BENCH_BATCH": "32"}, "bench.py", 2700),
+                ("resnet50", {}, "bench.py", 2700),
+                # retry slot: only runs if the row above timed out —
+                # the persistent compile cache makes the second
+                # attempt cheap, but a successful first run must not
+                # burn a scarce window twice
+                ("resnet50_retry", {}, "bench.py", 2700),
+                ("resnet50_b128", {"MXTPU_BENCH_BATCH": "128"},
+                 "bench.py", 2700),
                 ("transformer_l4096",   # long-context: streaming
                  {"MXTPU_BENCH_MODEL": "transformer",  # flash path
                   "MXTPU_BENCH_BATCH": "2",
-                  "MXTPU_BENCH_SEQ": "4096"}, "bench.py"),
+                  "MXTPU_BENCH_SEQ": "4096"}, "bench.py", 2700),
                 ("transformer_l4096_w512",  # banded (sliding-window)
                  {"MXTPU_BENCH_MODEL": "transformer",
                   "MXTPU_BENCH_BATCH": "2",
                   "MXTPU_BENCH_SEQ": "4096",
-                  "MXTPU_BENCH_WINDOW": "512"}, "bench.py"),
-                ("resnet50_b128", {"MXTPU_BENCH_BATCH": "128"},
-                 "bench.py"),
+                  "MXTPU_BENCH_WINDOW": "512"}, "bench.py", 2700),
                 ("pipeline", {"MXTPU_BENCH_MODEL": "pipeline"},
-                 "bench.py")]:
-            res = run_bench(mode, env, script=script)
+                 "bench.py", 2700),
+                ("bandwidth", {}, "tools/bandwidth.py", 1200)]:
+            if mode.endswith("_retry"):
+                prev = suite["runs"][-1] if suite["runs"] else None
+                if prev is None or prev["rc"] == 0:
+                    continue   # first attempt succeeded — move on
+            res = run_bench(mode, env, timeout_s=tmo, script=script)
             suite["runs"].append(res)
             ok = res["result"] is not None and res["rc"] == 0
             print(f"    {mode}: rc={res['rc']} "
