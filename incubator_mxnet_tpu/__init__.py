@@ -65,6 +65,7 @@ from .monitor import Monitor
 from . import visualization
 from . import parallel
 from . import contrib
+from .utils.env import list_env
 
 __all__ = ["nd", "ndarray", "autograd", "Context", "cpu", "tpu", "gpu",
            "random", "NDArray", "TShape", "sym", "symbol", "Symbol",
