@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Large-margin classification with SVMOutput (ref role:
+example/svm_mnist/svm_mnist.py — swap SoftmaxOutput for SVMOutput to
+train an L2-regularized multiclass hinge head on MNIST features).
+
+Both SVM modes are exercised: squared hinge (default) and L1 hinge
+(``use_linear=True``), trained through Module on the synthetic MNIST
+stand-in.  The gate also checks the margin property that motivates
+the op: correct-class scores beat runner-ups by >= the margin on
+most validation samples.
+
+--quick is the CI gate: accuracy > 0.9 for both hinge variants and
+mean margin satisfaction > 0.8.
+"""
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="SVMOutput on MNIST")
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--epochs", type=int, default=10)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--margin", type=float, default=1.0)
+    p.add_argument("--quick", action="store_true")
+    return p.parse_args(argv)
+
+
+def synthetic_digits(n, rs):
+    x = rs.rand(n, 784).astype(np.float32) * 0.3
+    y = rs.randint(0, 10, n)
+    img = x.reshape(n, 28, 28)
+    for i in range(n):
+        c = y[i]
+        if c < 5:
+            img[i, 4 + 4 * c:7 + 4 * c, 4:24] += 0.7
+        else:
+            img[i, 4:24, 4 + 4 * (c - 5):7 + 4 * (c - 5)] += 0.7
+    return x, y.astype(np.float32)
+
+
+def train_one(mx, xtr, ytr, xva, yva, args, use_linear):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=128, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc2")
+    net = mx.sym.SVMOutput(net, margin=args.margin,
+                           regularization_coefficient=1.0,
+                           use_linear=use_linear, name="svm")
+    mod = mx.mod.Module(net, data_names=["data"],
+                        label_names=["svm_label"])
+    it = mx.io.NDArrayIter({"data": xtr}, {"svm_label": ytr},
+                           batch_size=args.batch_size, shuffle=True,
+                           last_batch_handle="discard")
+    mod.bind(data_shapes=it.provide_data,
+             label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd", optimizer_params=dict(
+        learning_rate=args.lr, momentum=0.9, wd=1e-4))
+    for ep in range(args.epochs):
+        it.reset()
+        for batch in it:
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+
+    va = mx.io.NDArrayIter({"data": xva}, {"svm_label": yva},
+                           batch_size=args.batch_size,
+                           last_batch_handle="discard")
+    hits = tot = margin_ok = 0
+    for batch in va:
+        mod.forward(batch, is_train=False)
+        scores = np.array(mod.get_outputs()[0].asnumpy())
+        lab = batch.label[0].asnumpy().astype(int)
+        pred = scores.argmax(1)
+        hits += int((pred == lab).sum())
+        tot += len(lab)
+        true = scores[np.arange(len(lab)), lab]
+        scores[np.arange(len(lab)), lab] = -np.inf
+        runner = scores.max(1)
+        margin_ok += int((true - runner >= args.margin).sum())
+    return hits / tot, margin_ok / tot
+
+
+def main(argv=None):
+    from incubator_mxnet_tpu.utils.platform import maybe_force_cpu
+    maybe_force_cpu()
+    args = parse_args(argv)
+    if args.quick:
+        args.epochs = 8
+
+    import incubator_mxnet_tpu as mx
+
+    mx.random.seed(0)
+    rs = np.random.RandomState(0)
+    xtr, ytr = synthetic_digits(2048, rs)
+    xva, yva = synthetic_digits(512, np.random.RandomState(1))
+
+    acc_sq, marg_sq = train_one(mx, xtr, ytr, xva, yva, args,
+                                use_linear=False)
+    acc_l1, marg_l1 = train_one(mx, xtr, ytr, xva, yva, args,
+                                use_linear=True)
+
+    summary = dict(squared_hinge_acc=float(acc_sq),
+                   l1_hinge_acc=float(acc_l1),
+                   margin_satisfaction=float(min(marg_sq, marg_l1)))
+    print(json.dumps(summary))
+    if args.quick:
+        assert acc_sq > 0.9 and acc_l1 > 0.9, summary
+        # both hinge variants must actually enforce the margin
+        assert min(marg_sq, marg_l1) > 0.8, summary
+    return summary
+
+
+if __name__ == "__main__":
+    main()

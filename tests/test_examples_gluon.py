@@ -36,6 +36,8 @@ _QUICK = [
     "dcgan",
     "actor_critic",
     "adversary_fgsm",
+    "fcn_segmentation",
+    "svm_mnist",
 ]
 
 
