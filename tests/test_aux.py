@@ -272,3 +272,70 @@ def test_monitor_tapped_mode_warns(caplog):
         assert not caplog.records
     finally:
         logger.propagate = False
+
+
+def test_tensorboard_log_metrics_callback(tmp_path):
+    """Contrib TensorBoard bridge (ref: contrib/tensorboard.py
+    LogMetricsCallback): metrics stream to a writer; the JSONL
+    fallback is asserted directly so the test needs no tensorboard."""
+    import json as _json
+    from collections import namedtuple
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.contrib.tensorboard import (
+        LogMetricsCallback, _JsonlWriter)
+
+    metric = mx.metric.Accuracy()
+    metric.update([mx.nd.array([0.0, 1.0])],
+                  [mx.nd.array([[0.9, 0.1], [0.2, 0.8]])])
+    Param = namedtuple("BatchEndParam",
+                       ["epoch", "nbatch", "eval_metric"])
+    logdir = str(tmp_path / "tb")
+    cb = LogMetricsCallback(
+        logdir, prefix="train",
+        summary_writer=_JsonlWriter(logdir))
+    cb(Param(epoch=0, nbatch=1, eval_metric=metric))
+    cb(Param(epoch=0, nbatch=2, eval_metric=metric))
+    files = [f for f in os.listdir(logdir) if f.endswith(".jsonl")]
+    assert files
+    rows = [_json.loads(l) for l in
+            open(os.path.join(logdir, files[0]))]
+    assert [r["step"] for r in rows] == [1, 2]
+    assert all(r["tag"] == "train-accuracy" for r in rows)
+    assert all(r["value"] == 1.0 for r in rows)
+
+    # the real torch SummaryWriter path, when available
+    try:
+        from torch.utils.tensorboard import SummaryWriter  # noqa
+    except Exception:
+        return
+    cb2 = LogMetricsCallback(str(tmp_path / "tb2"), prefix="t")
+    cb2(Param(epoch=0, nbatch=1, eval_metric=metric))
+    cb2.writer.flush()
+    assert os.listdir(str(tmp_path / "tb2"))
+
+
+def test_contrib_autograd_legacy_api():
+    """contrib.autograd keeps the pre-1.0 experimental names alive
+    over the core tape (ref: python/mxnet/contrib/autograd.py)."""
+    import numpy as np
+
+    from incubator_mxnet_tpu import nd
+    from incubator_mxnet_tpu.contrib import autograd as cag
+
+    @cag.grad_and_loss
+    def f(a, b):
+        return a * b + a
+
+    x = nd.array(np.array([1., 2., 3.], np.float32))
+    y = nd.array(np.array([4., 5., 6.], np.float32))
+    grads, _ = f(x, y)
+    np.testing.assert_allclose(grads[0].asnumpy(), [5., 6., 7.])
+    np.testing.assert_allclose(grads[1].asnumpy(), [1., 2., 3.])
+
+    x2 = nd.array(np.array([2., 3.], np.float32))
+    x2.attach_grad()
+    with cag.train_section():
+        z = nd.sum(x2 * x2)
+    cag.compute_gradient([z])
+    np.testing.assert_allclose(x2.grad.asnumpy(), [4., 6.])
