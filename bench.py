@@ -184,6 +184,11 @@ def _bench_transformer(dev, platform):
                   f"to XLA attention — {type(exc).__name__}: "
                   f"{str(exc)[:300]}", file=sys.stderr)
 
+    def stage(msg):
+        print(f"bench[transformer {time.strftime('%H:%M:%S')}]: "
+              f"{msg}", file=sys.stderr, flush=True)
+
+    stage(f"flash_ok={flash_ok}; building model on host")
     with jax.default_device(cpu):
         mx.random.seed(0)
         net = TransformerLM(V, d_model=D, n_layers=LAYERS,
@@ -191,6 +196,7 @@ def _bench_transformer(dev, platform):
                             moe_experts=MOE, attn_window=WINDOW)
         net.initialize(mx.initializer.Xavier())
         ex = mx.nd.array(np.zeros((2, L), "int32"))
+    stage("model built; creating mesh step (uploads ~600 MB params)")
 
     def lm_loss(outputs, labels):
         # logsumexp - picked, NOT log_softmax: avoids materializing
@@ -217,11 +223,13 @@ def _bench_transformer(dev, platform):
 
     rs = np.random.RandomState(0)
     tgt = mesh_devs[0]
+    stage("step created; transferring token batch")
     toks = jax.device_put(
         np.asarray(rs.randint(0, V, (B, L)), np.int32), tgt)
     labels = jax.device_put(
         np.asarray(rs.randint(0, V, (B, L)), np.int32), tgt)
     float(jax.device_get(toks.reshape(-1)[:1])[0])
+    stage("batch resident; compiling + warming up")
 
     warm, meas = 2, 10
     t0 = time.perf_counter()
@@ -431,6 +439,15 @@ def main():
     x_np = np.asarray(rs.rand(BATCH, 3, 224, 224), np.float32)
     y_np = np.asarray(rs.randint(0, 1000, (BATCH,)), np.int32)
 
+    # stage breadcrumbs on stderr: a run killed by a driver timeout
+    # must show WHERE it was (the 2026-07-31 window's resnet rc=124
+    # left an empty trail — nothing printed between the probe and
+    # warmup over a 28-minute hang)
+    def stage(msg):
+        print(f"bench[{time.strftime('%H:%M:%S')}]: {msg}",
+              file=sys.stderr, flush=True)
+
+    stage("model built; creating mesh step (uploads params)")
     mesh_devs = [dev] if dev is not None else jax.devices("cpu")[:1]
     compute_dtype = jnp.bfloat16 if platform != "cpu" else None
     step = parallel.ShardedTrainStep(
@@ -446,9 +463,11 @@ def main():
     # host numpy per step re-paid a 0.24 GB/s tunnel transfer every
     # iteration and hid the actual 16 ms step under 1094 ms of I/O.
     tgt = mesh_devs[0]
+    stage("step created; settling async param upload")
     # settle the step's async param upload before opening the timer
     float(jax.device_get(next(iter(step.params.values()))
                          .reshape(-1)[:1])[0])
+    stage("params resident; transferring batch")
     t0 = time.perf_counter()
     x = jax.device_put(x_np, tgt)
     y = jax.device_put(y_np, tgt)
@@ -457,6 +476,8 @@ def main():
     float(jax.device_get(y.reshape(-1)[:1])[0])
     xfer_s = time.perf_counter() - t0
 
+    stage(f"batch resident ({xfer_s*1e3:.0f} ms); "
+          "compiling + warming up")
     rng = jax.random.PRNGKey(0)
     t0 = time.perf_counter()
     for _ in range(WARMUP_STEPS):

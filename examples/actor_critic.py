@@ -72,7 +72,6 @@ def main(argv=None):
     if args.quick:
         args.episodes = 150
 
-    import jax.numpy as jnp
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import autograd, gluon, nd
     from incubator_mxnet_tpu.gluon import nn
