@@ -26,6 +26,15 @@ import time
 import numpy as np
 
 BASELINE_IMG_S = 109.0  # ResNet-50 batch 32, 1x K80 (BASELINE.md)
+
+
+def _stage(msg, tag=""):
+    """Timestamped stderr breadcrumb: a run killed by a driver
+    timeout must show WHERE it was (the 2026-07-31 window's resnet
+    rc=124 left an empty trail between probe and warmup)."""
+    label = f"bench[{tag} " if tag else "bench["
+    print(f"{label}{time.strftime('%H:%M:%S')}]: {msg}",
+          file=sys.stderr, flush=True)
 BATCH = int(os.environ.get("MXTPU_BENCH_BATCH", "32"))
 WARMUP_STEPS = 3
 MEASURE_STEPS = 20
@@ -185,8 +194,7 @@ def _bench_transformer(dev, platform):
                   f"{str(exc)[:300]}", file=sys.stderr)
 
     def stage(msg):
-        print(f"bench[transformer {time.strftime('%H:%M:%S')}]: "
-              f"{msg}", file=sys.stderr, flush=True)
+        _stage(msg, tag="transformer")
 
     stage(f"flash_ok={flash_ok}; building model on host")
     with jax.default_device(cpu):
@@ -439,14 +447,7 @@ def main():
     x_np = np.asarray(rs.rand(BATCH, 3, 224, 224), np.float32)
     y_np = np.asarray(rs.randint(0, 1000, (BATCH,)), np.int32)
 
-    # stage breadcrumbs on stderr: a run killed by a driver timeout
-    # must show WHERE it was (the 2026-07-31 window's resnet rc=124
-    # left an empty trail — nothing printed between the probe and
-    # warmup over a 28-minute hang)
-    def stage(msg):
-        print(f"bench[{time.strftime('%H:%M:%S')}]: {msg}",
-              file=sys.stderr, flush=True)
-
+    stage = _stage
     stage("model built; creating mesh step (uploads params)")
     mesh_devs = [dev] if dev is not None else jax.devices("cpu")[:1]
     compute_dtype = jnp.bfloat16 if platform != "cpu" else None

@@ -1,0 +1,175 @@
+#!/usr/bin/env python
+"""Stochastic-depth residual training (ref role:
+example/stochastic-depth/sd_cifar10.py — randomly skip whole
+residual blocks during training with linearly-decaying survival
+probability; at test time every block runs, scaled by its survival
+probability).
+
+Gluon imperative path: the per-batch block gates are sampled on the
+host (exactly the reference's death_rate mechanics) and the skipped
+blocks contribute identity only — their parameters receive zero
+gradient that step, which the gate below asserts directly.
+
+--quick is the CI gate: validation accuracy > 0.9 on the synthetic
+digit task AND a measured property: with a block forced dead for one
+step its conv weights get exactly zero gradient while the surviving
+blocks' are nonzero.
+"""
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="stochastic depth")
+    p.add_argument("--blocks", type=int, default=4)
+    p.add_argument("--death-rate", type=float, default=0.3,
+                   help="max death prob (linear ramp over depth)")
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--epochs", type=int, default=8)
+    p.add_argument("--lr", type=float, default=2e-3)
+    p.add_argument("--quick", action="store_true")
+    return p.parse_args(argv)
+
+
+def synthetic_digits(n, rs):
+    x = rs.rand(n, 1, 28, 28).astype(np.float32) * 0.3
+    y = rs.randint(0, 10, n)
+    for i in range(n):
+        c = y[i]
+        if c < 5:
+            x[i, 0, 4 + 4 * c:7 + 4 * c, 4:24] += 0.7
+        else:
+            x[i, 0, 4:24, 4 + 4 * (c - 5):7 + 4 * (c - 5)] += 0.7
+    return x, y.astype(np.float32)
+
+
+def main(argv=None):
+    from incubator_mxnet_tpu.utils.platform import maybe_force_cpu
+    maybe_force_cpu()
+    args = parse_args(argv)
+    if args.quick:
+        args.epochs = 6
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, gluon, nd
+    from incubator_mxnet_tpu.gluon import nn
+
+    class ResBlock(gluon.Block):
+        """The residual FUNCTION f(x) only; the net owns the skip,
+        so the death gate multiplies exactly f (Huang et al.'s
+        formulation: train relu(x + b*f(x)), eval relu(x + p*f(x)))."""
+
+        def __init__(self, ch, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.conv1 = nn.Conv2D(ch, 3, padding=1,
+                                       activation="relu")
+                self.conv2 = nn.Conv2D(ch, 3, padding=1)
+
+        def forward(self, x):
+            return self.conv2(self.conv1(x))
+
+    class SDNet(gluon.Block):
+        """Residual stack with per-block survival probability
+        p_l = 1 - l/L * death_rate (the reference's linear ramp)."""
+
+        def __init__(self, blocks, death_rate, **kw):
+            super().__init__(**kw)
+            self.survival = [1.0 - (l + 1) / blocks * death_rate
+                             for l in range(blocks)]
+            with self.name_scope():
+                self.stem = nn.Conv2D(16, 3, strides=2, padding=1,
+                                      activation="relu")
+                self.blocks = []
+                for i in range(blocks):
+                    b = ResBlock(16)
+                    setattr(self, f"block{i}", b)
+                    self.blocks.append(b)
+                # Flatten, not GAP: the synthetic digit
+                # classes are POSITIONAL (bar offset); global
+                # average pooling would erase exactly the signal
+                self.pool = nn.Flatten()
+                self.head = nn.Dense(10)
+
+        def forward(self, x, gates=None):
+            """gates: per-block 0/1 alive mask (training); None =
+            deterministic eval with survival scaling."""
+            h = self.stem(x)
+            for i, b in enumerate(self.blocks):
+                if gates is None:               # eval: E[gate] scaling
+                    h = nd.relu(h + self.survival[i] * b(h))
+                elif gates[i]:                  # alive this batch
+                    h = nd.relu(h + b(h))
+                # dead: identity — the block sees no gradient
+            return self.head(self.pool(h))
+
+    mx.random.seed(0)
+    rs = np.random.RandomState(0)
+    xtr, ytr = synthetic_digits(2048, rs)
+    xva, yva = synthetic_digits(512, np.random.RandomState(1))
+
+    net = SDNet(args.blocks, args.death_rate)
+    net.initialize(mx.init.Xavier())
+    # settle every block's deferred shapes with one deterministic
+    # forward: a block can be dead for the first training batches
+    # and its params must exist before the Trainer touches them
+    net(nd.array(xtr[:2]))
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    for ep in range(args.epochs):
+        perm = rs.permutation(len(xtr))
+        for i in range(0, len(xtr) - args.batch_size + 1,
+                       args.batch_size):
+            xb = nd.array(xtr[perm[i:i + args.batch_size]])
+            yb = nd.array(ytr[perm[i:i + args.batch_size]])
+            gates = [rs.rand() < p for p in net.survival]
+            with autograd.record():
+                loss = loss_fn(net(xb, gates), yb).mean()
+            loss.backward()
+            trainer.step(args.batch_size)
+        print(f"epoch {ep} done", flush=True)
+
+    preds = net(nd.array(xva)).asnumpy().argmax(1)
+    acc = float((preds == yva).mean())
+
+    # property gate: a dead block gets exactly zero gradient while a
+    # live one doesn't.  Checked on a FRESH net — the converged one's
+    # gradients are ~1e-17 (saturated softmax), too close to zero to
+    # assert against.
+    net2 = SDNet(args.blocks, args.death_rate)
+    net2.initialize(mx.init.Xavier())
+    net2(nd.array(xva[:2]))
+    xb = nd.array(xva[:32])
+    yb = nd.array(yva[:32])
+    gates = [True] * args.blocks
+    gates[1] = False
+    with autograd.record():
+        loss = loss_fn(net2(xb, gates), yb).mean()
+    loss.backward()
+    dead_g = sum(float(np.abs(p.grad().asnumpy()).sum())
+                 for p in net2.blocks[1].collect_params().values())
+    live_g = sum(float(np.abs(p.grad().asnumpy()).sum())
+                 for p in net2.blocks[0].collect_params().values())
+
+    summary = dict(val_acc=acc, dead_block_grad=dead_g,
+                   live_block_grad=live_g,
+                   survival=net.survival)
+    print(json.dumps(summary))
+    if args.quick:
+        assert acc > 0.9, summary
+        assert dead_g == 0.0, summary
+        assert live_g > 0.0, summary
+    return summary
+
+
+if __name__ == "__main__":
+    main()

@@ -494,6 +494,49 @@ class NDArray:
 # the imperative invoke path (role of Imperative::Invoke)
 # ---------------------------------------------------------------------------
 
+# Stable jitted fwd/bwd pairs for ops flagged ``cache_vjp`` (RNN,
+# ctc_loss — anything binding lax.scan).  The generic path below
+# builds a fresh closure per call; jax's scan compile cache keys on
+# jaxpr identity, so every eager call would pay a full XLA compile
+# (measured: 4 scan compiles/step training one BiLSTM; long loops
+# eventually died in LLVM with ENOMEM).  A pair is keyed on the op +
+# its hashable params; array-valued params (e.g. the RNN dropout
+# key) travel as traced leading arguments.  The jitted bwd
+# recomputes the forward (remat) — the eager-mode trade that buys a
+# once-per-shape compile; the compiled training paths (executor /
+# ShardedTrainStep) never come through here.
+_STABLE_PAIRS = {}
+
+
+def _stable_pair(op, params):
+    static, tensor = {}, {}
+    for k, v in params.items():
+        if isinstance(v, (jnp.ndarray, jax.Array, np.ndarray)):
+            tensor[k] = v
+        else:
+            static[k] = tuple(v) if isinstance(v, list) else v
+    tnames = tuple(sorted(tensor))
+    try:
+        key = (op.name, tuple(sorted(static.items())), tnames)
+        pair = _STABLE_PAIRS.get(key)
+    except TypeError:        # unhashable param value — no caching
+        return None
+    if pair is None:
+        fn = op.fn
+
+        def fwd_raw(tvals, *xs):
+            return fn(*xs, **static, **dict(zip(tnames, tvals)))
+
+        def bwd_raw(tvals, xs, cts):
+            _, vjp = jax.vjp(lambda *a: fwd_raw(tvals, *a), *xs)
+            return vjp(cts)
+
+        pair = (jax.jit(fwd_raw), jax.jit(bwd_raw))
+        _STABLE_PAIRS[key] = pair
+    jfwd, jbwd = pair
+    tvals = tuple(tensor[k] for k in tnames)
+    return jfwd, jbwd, tvals
+
 
 def imperative_invoke(op, args, kwargs, out=None):
     """Execute a registered op on NDArrays; records for autograd."""
@@ -528,7 +571,16 @@ def imperative_invoke(op, args, kwargs, out=None):
 
     recording = (autograd.is_recording() and op.differentiable
                  and any(isinstance(n, NDArray) for n in nd_inputs))
-    if recording:
+    pair = _stable_pair(op, params) if op.cache_vjp else None
+    if pair is not None:
+        jfwd, jbwd, tvals = pair
+        outs = jfwd(tvals, *jargs)
+        if recording:
+            jargs_t = tuple(jargs)
+
+            def vjp_fn(cts):
+                return jbwd(tvals, jargs_t, cts)
+    elif recording:
         outs, vjp_fn = jax.vjp(fn, *jargs)
     else:
         outs = fn(*jargs)

@@ -189,7 +189,8 @@ def _mbt_one(anchors, lab, cls_pred, overlap_threshold, ignore_label,
     return loc_t, loc_m, cls_t
 
 
-@defop("_contrib_MultiBoxTarget", num_outputs=3, differentiable=False)
+@defop("_contrib_MultiBoxTarget", num_outputs=3, differentiable=False,
+       cache_vjp=True)
 def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
                     ignore_label=-1.0, negative_mining_ratio=-1.0,
                     negative_mining_thresh=0.5,
@@ -292,7 +293,8 @@ def _mbd_one(cls_prob, loc_pred, anchors, threshold, clip, variances,
     return jnp.where(present[:, None], out, -1.0)
 
 
-@defop("_contrib_MultiBoxDetection", differentiable=False)
+@defop("_contrib_MultiBoxDetection", differentiable=False,
+       cache_vjp=True)
 def multibox_detection(cls_prob, loc_pred, anchor, clip=True,
                        threshold=0.01, background_id=0,
                        nms_threshold=0.5, force_suppress=False,
@@ -527,7 +529,7 @@ def _proposal_one(fg_scores, bbox_deltas, im_info, base_anchors,
     return rois, s[idx][:, None]
 
 
-@defop("_contrib_Proposal", num_outputs=lambda p:
+@defop("_contrib_Proposal", cache_vjp=True, num_outputs=lambda p:
        2 if p.get("output_score", False) else 1, differentiable=False)
 def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
              rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
@@ -555,7 +557,7 @@ def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
     return rois
 
 
-@defop("_contrib_MultiProposal", num_outputs=lambda p:
+@defop("_contrib_MultiProposal", cache_vjp=True, num_outputs=lambda p:
        2 if p.get("output_score", False) else 1, differentiable=False)
 def multi_proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
                    rpn_post_nms_top_n=300, threshold=0.7,

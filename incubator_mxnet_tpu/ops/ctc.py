@@ -82,7 +82,8 @@ def _ctc_single(log_probs, labels, T_len, L_len, blank):
 
 
 @defop("ctc_loss", aliases=("_contrib_CTCLoss", "CTCLoss",
-                            "_contrib_ctc_loss"), variadic=True)
+                            "_contrib_ctc_loss"), variadic=True,
+       cache_vjp=True)
 def ctc_loss(*inputs, use_data_lengths=False, use_label_lengths=False,
              blank_label="first"):
     """CTC loss (ref: src/operator/contrib/ctc_loss.cc).

@@ -43,11 +43,13 @@ class OpDef:
 
     __slots__ = ("name", "fn", "num_outputs", "variadic", "needs_mode",
                  "needs_rng", "num_aux", "arg_names", "aux_names",
-                 "differentiable", "param_defaults", "doc")
+                 "differentiable", "param_defaults", "doc",
+                 "cache_vjp")
 
     def __init__(self, name, fn, num_outputs=1, variadic=False,
                  needs_mode=False, needs_rng=False, num_aux=0,
-                 arg_names=None, aux_names=None, differentiable=True):
+                 arg_names=None, aux_names=None, differentiable=True,
+                 cache_vjp=False):
         self.name = name
         self.fn = fn
         self.num_outputs = num_outputs
@@ -57,6 +59,15 @@ class OpDef:
         self.num_aux = num_aux
         self.aux_names = aux_names or []
         self.differentiable = differentiable
+        # Ops whose fn binds composite control-flow primitives
+        # (lax.scan / while) must dispatch through a STABLE cached
+        # jit pair in eager mode: the generic per-call jax.vjp on a
+        # fresh closure re-traces a fresh jaxpr, and scan's compile
+        # cache keys on jaxpr identity — so every eager step paid a
+        # full XLA compile (and LLVM eventually exhausted memory on
+        # long loops).  Per-primitive eager caches cover everything
+        # else, so this stays opt-in.
+        self.cache_vjp = cache_vjp
         self.doc = fn.__doc__ or ""
         if arg_names is None and not variadic:
             sig = inspect.signature(fn)

@@ -133,6 +133,7 @@ def _rnn_num_outputs(params):
 
 
 @defop("RNN", variadic=True, needs_rng=True, needs_mode=True,
+       cache_vjp=True,
        num_outputs=_rnn_num_outputs)
 def rnn(*args, state_size=0, num_layers=1, mode="lstm",
         bidirectional=False, p=0.0, state_outputs=False,

@@ -38,6 +38,8 @@ _QUICK = [
     "adversary_fgsm",
     "fcn_segmentation",
     "svm_mnist",
+    "bi_lstm_sort",
+    "stochastic_depth",
 ]
 
 
