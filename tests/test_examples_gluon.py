@@ -40,6 +40,7 @@ _QUICK = [
     "svm_mnist",
     "bi_lstm_sort",
     "stochastic_depth",
+    "profiler_demo",
 ]
 
 
