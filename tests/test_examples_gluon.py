@@ -41,6 +41,7 @@ _QUICK = [
     "bi_lstm_sort",
     "stochastic_depth",
     "profiler_demo",
+    "captcha_crnn",
 ]
 
 
