@@ -42,6 +42,7 @@ _QUICK = [
     "stochastic_depth",
     "profiler_demo",
     "captcha_crnn",
+    "neural_style",
 ]
 
 
