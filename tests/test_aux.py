@@ -339,3 +339,26 @@ def test_contrib_autograd_legacy_api():
         z = nd.sum(x2 * x2)
     cag.compute_gradient([z])
     np.testing.assert_allclose(x2.grad.asnumpy(), [4., 6.])
+
+
+def test_enable_compile_cache_persists(tmp_path):
+    """utils.platform.enable_compile_cache points jax's persistent
+    executable cache at a directory; a compile must leave an entry
+    (the mechanism that lets a timed-out cold compile over the
+    tunnel seed the next bench attempt)."""
+    import jax
+    import jax.numpy as jnp
+
+    from incubator_mxnet_tpu.utils.platform import \
+        enable_compile_cache
+
+    cachedir = str(tmp_path / "xla-cache")
+    assert enable_compile_cache(cachedir)
+    try:
+        # unique shape so the compile can't be a jit-cache hit
+        x = jnp.ones((13, 29), jnp.float32)
+        jax.block_until_ready(jax.jit(lambda a: (a @ a.T).sum())(x))
+        entries = os.listdir(cachedir)
+        assert entries, "no persistent cache entry written"
+    finally:
+        jax.config.update("jax_compilation_cache_dir", None)
