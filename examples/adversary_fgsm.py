@@ -34,17 +34,7 @@ def parse_args(argv=None):
     return p.parse_args(argv)
 
 
-def synthetic_digits(n, rs):
-    x = rs.rand(n, 784).astype(np.float32) * 0.3
-    y = rs.randint(0, 10, n)
-    img = x.reshape(n, 28, 28)
-    for i in range(n):
-        c = y[i]
-        if c < 5:
-            img[i, 4 + 4 * c:7 + 4 * c, 4:24] += 0.7
-        else:
-            img[i, 4:24, 4 + 4 * (c - 5):7 + 4 * (c - 5)] += 0.7
-    return x, y.astype(np.float32)
+from common import synthetic_digits  # noqa: E402
 
 
 def main(argv=None):

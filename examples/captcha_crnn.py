@@ -91,14 +91,7 @@ def parse_args(argv=None):
     return p.parse_args(argv)
 
 
-def edit_distance(a, b):
-    dp = np.arange(len(b) + 1)
-    for i, ca in enumerate(a, 1):
-        prev, dp[0] = dp[0], i
-        for j, cb in enumerate(b, 1):
-            prev, dp[j] = dp[j], min(dp[j] + 1, dp[j - 1] + 1,
-                                     prev + (ca != cb))
-    return int(dp[-1])
+from common import edit_distance  # noqa: E402
 
 
 def greedy_decode(logits):

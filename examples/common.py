@@ -1,0 +1,39 @@
+"""Shared example helpers (ref role: example/image-classification/
+common/ — the reference's examples also factor repeated data/eval
+helpers into a sibling module rather than copy them per script).
+
+Import works both as a script sibling (``python examples/x.py`` puts
+this directory on sys.path) and in-process from the tests (which
+insert the examples dir explicitly).
+"""
+import numpy as np
+
+
+def synthetic_digits(n, rs, flat=True):
+    """Class-conditional 28x28 'digits': a bright bar whose position
+    and orientation encode the class — learnable to ~1.0 by a small
+    net, zero-egress.  Returns (x, y) with x flattened to (n, 784)
+    unless ``flat=False`` (then (n, 1, 28, 28))."""
+    x = rs.rand(n, 1, 28, 28).astype(np.float32) * 0.3
+    y = rs.randint(0, 10, n)
+    for i in range(n):
+        c = y[i]
+        if c < 5:
+            x[i, 0, 4 + 4 * c:7 + 4 * c, 4:24] += 0.7
+        else:
+            x[i, 0, 4:24, 4 + 4 * (c - 5):7 + 4 * (c - 5)] += 0.7
+    if flat:
+        x = x.reshape(n, 784)
+    return x, y.astype(np.float32)
+
+
+def edit_distance(a, b):
+    """Levenshtein distance between two sequences (for label error
+    rates in the CTC examples)."""
+    dp = np.arange(len(b) + 1)
+    for i, ca in enumerate(a, 1):
+        prev, dp[0] = dp[0], i
+        for j, cb in enumerate(b, 1):
+            prev, dp[j] = dp[j], min(dp[j] + 1, dp[j - 1] + 1,
+                                     prev + (ca != cb))
+    return int(dp[-1])

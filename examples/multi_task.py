@@ -30,16 +30,7 @@ def parse_args(argv=None):
     return p.parse_args(argv)
 
 
-def synthetic_digits(n, rs):
-    x = rs.rand(n, 1, 28, 28).astype(np.float32) * 0.3
-    y = rs.randint(0, 10, n)
-    for i in range(n):
-        c = y[i]
-        if c < 5:
-            x[i, 0, 4 + 4 * c:7 + 4 * c, 4:24] += 0.7
-        else:
-            x[i, 0, 4:24, 4 + 4 * (c - 5):7 + 4 * (c - 5)] += 0.7
-    return x.reshape(n, 784), y.astype(np.float32)
+from common import synthetic_digits  # noqa: E402
 
 
 def build(mx):

@@ -62,14 +62,7 @@ def make_utterances(rs, n, templates):
     return X, Y, xl, yl
 
 
-def edit_distance(a, b):
-    dp = np.arange(len(b) + 1)
-    for i, ca in enumerate(a, 1):
-        prev, dp[0] = dp[0], i
-        for j, cb in enumerate(b, 1):
-            prev, dp[j] = dp[j], min(dp[j] + 1, dp[j - 1] + 1,
-                                     prev + (ca != cb))
-    return int(dp[-1])
+from common import edit_distance  # noqa: E402
 
 
 def greedy_decode(logits, length):
@@ -92,7 +85,7 @@ def main(argv=None):
 
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import autograd, gluon, nd
-    from incubator_mxnet_tpu.gluon import nn, rnn
+    from incubator_mxnet_tpu.gluon import nn, rnn, utils as gutils
 
     class AcousticModel(gluon.Block):
         def __init__(self, hidden, **kw):
@@ -145,7 +138,6 @@ def main(argv=None):
         # CTC gradients spike when an alignment collapses; global
         # clipping keeps adam from running off (the reference's
         # speech examples clip the same way)
-        from incubator_mxnet_tpu.gluon import utils as gutils
         gutils.clip_global_norm(
             [p.grad() for p in net.collect_params().values()
              if p.grad_req != "null"], args.clip)
