@@ -78,6 +78,9 @@ def run_bench(mode, extra_env, timeout_s=1800, script="bench.py"):
             "seconds": round(time.time() - t0, 1),
             "result": all_json[-1] if all_json else None,
             "results": all_json,        # schema-stable: always a list
+            # human-format tools (profile_step) report via stdout
+            # prose, not JSON lines — keep it
+            "stdout_tail": out[-2000:],
             "stderr_tail": err[-1500:]}
 
 
@@ -147,7 +150,12 @@ def main():
                   "MXTPU_BENCH_WINDOW": "512"}, "bench.py", 2700),
                 ("pipeline", {"MXTPU_BENCH_MODEL": "pipeline"},
                  "bench.py", 2700),
-                ("bandwidth", {}, "tools/bandwidth.py", 1200)]:
+                ("bandwidth", {}, "tools/bandwidth.py", 1200),
+                # step-time decomposition incl. the BN-stats delta
+                # vs the r3 trace (VERDICT r4 next-step 4); prose
+                # output lands in stdout_tail
+                ("profile_step", {}, "tools/profile_step.py",
+                 2400)]:
             if mode.endswith("_retry"):
                 prev = suite["runs"][-1] if suite["runs"] else None
                 if prev is None or prev["rc"] == 0:
