@@ -16,6 +16,39 @@ DEFAULT_PATHS = ["incubator_mxnet_tpu", "tools", "examples", "ci",
                  "bench.py", "__graft_entry__.py"]
 MAX_LINE = 100
 
+# Framework modules that write checkpoint/state files.  In these,
+# a bare ``open(path, "wb")`` is forbidden: a crash mid-write leaves
+# a truncated file that poisons the next resume.  All checkpoint
+# bytes must flow through resilience.atomic_save/atomic_write_bytes
+# (temp + fsync + rename + CRC32 sidecar).
+CKPT_MODULES = (
+    "incubator_mxnet_tpu/model.py",
+    "incubator_mxnet_tpu/kvstore.py",
+    "incubator_mxnet_tpu/callback.py",
+    "incubator_mxnet_tpu/ndarray/ndarray.py",
+    "incubator_mxnet_tpu/gluon/parameter.py",
+    "incubator_mxnet_tpu/gluon/trainer.py",
+    "incubator_mxnet_tpu/gluon/block.py",
+    "incubator_mxnet_tpu/module/",
+)
+
+
+def _is_binary_write_open(node):
+    """True for ``open(..., "wb"/"wb+"/...)`` calls."""
+    if not (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "open"):
+        return False
+    mode = None
+    if len(node.args) >= 2:
+        mode = node.args[1]
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    return (isinstance(mode, ast.Constant)
+            and isinstance(mode.value, str)
+            and "w" in mode.value and "b" in mode.value)
+
 
 def _imported_names(tree):
     """name -> lineno for every import binding."""
@@ -60,7 +93,18 @@ def check_file(path):
             problems.append(
                 f"{path}:{lineno}: unused import '{name}'")
 
+    posix = path.as_posix()
+    in_ckpt_module = any(
+        posix.endswith(m) or (m.endswith("/") and m in posix)
+        for m in CKPT_MODULES)
+
     for node in ast.walk(tree):
+        if in_ckpt_module and _is_binary_write_open(node):
+            problems.append(
+                f"{path}:{node.lineno}: bare open(..., 'wb') in "
+                "checkpoint-writing module — use resilience."
+                "atomic_save/atomic_write_bytes so saves are "
+                "atomic and checksummed")
         if (not is_init and isinstance(node, ast.ImportFrom)
                 and any(a.name == "*" for a in node.names)):
             # __init__.py wildcard re-exports are the namespace

@@ -46,6 +46,7 @@ from . import kvstore as kv
 from . import model
 from . import test_utils
 from . import dist
+from . import resilience
 from . import predictor
 from .predictor import Predictor
 from .model import load_checkpoint, save_checkpoint
@@ -73,4 +74,5 @@ __all__ = ["nd", "ndarray", "autograd", "Context", "cpu", "tpu", "gpu",
            "lr_scheduler", "metric", "callback", "kvstore", "model",
            "module", "mod", "Module", "gluon", "DataBatch", "DataDesc",
            "DataIter", "NDArrayIter", "load_checkpoint",
-           "save_checkpoint", "__version__"]
+           "save_checkpoint", "list_env", "resilience",
+           "__version__"]

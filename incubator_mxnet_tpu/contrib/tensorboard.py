@@ -54,25 +54,51 @@ class LogMetricsCallback:
 
     >>> cb = LogMetricsCallback('./logs', prefix='train')
     >>> mod.fit(it, batch_end_callback=cb, ...)
+    >>> cb.close()          # or: with LogMetricsCallback(...) as cb:
 
     Same call contract as the reference's: invoked with a
     ``BatchEndParam``-style object carrying ``epoch``, ``nbatch``
-    and ``eval_metric``.
+    and ``eval_metric``.  Owns the writer it creates (closing it on
+    close()/exit releases the underlying fd); an explicitly passed
+    ``summary_writer`` stays the caller's to close.
     """
 
     def __init__(self, logging_dir, prefix=None,
                  summary_writer=None):
         self.prefix = prefix
         self.step = 0
+        self._owns_writer = summary_writer is None
         self.writer = summary_writer or make_writer(logging_dir)
 
     def __call__(self, param):
+        if self.writer is None:
+            raise ValueError(
+                "LogMetricsCallback was closed; create a new one "
+                "for further logging")
         if param.eval_metric is None:
             return
         self.step += 1
         for name, value in self._pairs(param.eval_metric):
             tag = f"{self.prefix}-{name}" if self.prefix else name
             self.writer.add_scalar(tag, value, self.step)
+
+    def close(self):
+        w, self.writer = self.writer, None
+        if w is not None and self._owns_writer and \
+                hasattr(w, "close"):
+            w.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     @staticmethod
     def _pairs(metric):

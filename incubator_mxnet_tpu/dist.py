@@ -61,8 +61,18 @@ def init(coordinator_address=None, num_workers_=None, rank_=None):
 
     Arguments default to the launcher's env contract; returns the
     process rank.  Single-process (no env, no args) is a no-op.
+
+    The coordinator join is retried with exponential backoff
+    (resilience.RetryPolicy env knobs): rank 0 may still be binding
+    its port when late-spawned workers first connect, and transient
+    DNS/socket errors are routine during elastic restarts.  The
+    launcher-provided heartbeat (MXTPU_HEARTBEAT_FILE) starts here so
+    the monitor can tell this process is alive even while it blocks
+    in a collective.
     """
     global _initialized
+    from . import resilience
+    resilience.start_heartbeat()
     import jax
     if _initialized:
         return jax.process_index()
@@ -75,8 +85,49 @@ def init(coordinator_address=None, num_workers_=None, rank_=None):
         raise RuntimeError(
             "MXTPU_NUM_WORKERS>1 but no MXTPU_COORD_ADDR; launch "
             "through tools/launch.py or pass coordinator_address")
-    jax.distributed.initialize(coordinator_address=coord,
-                               num_processes=n, process_id=r)
+
+    # retry only connection-shaped failures (coordinator still
+    # binding, transient DNS/socket errors); a permanent
+    # misconfiguration — bad num_processes, malformed address —
+    # should fail on the first attempt, not after the full backoff
+    def reset_failed_join():
+        """jax sets global_state.client/.service *before* connect(),
+        so a failed join leaves them populated and the next
+        initialize raises 'should only be called once' — masking the
+        real transient error and making the retry a no-op.  Clear
+        the globals so each attempt starts clean."""
+        try:
+            from jax._src.distributed import global_state
+        except ImportError:
+            try:
+                jax.distributed.shutdown()
+            except Exception:
+                pass
+            return
+        try:
+            global_state.shutdown()
+        except Exception:
+            pass
+        # a client that never connected can refuse shutdown();
+        # null the slots regardless
+        global_state.client = None
+        global_state.service = None
+        global_state.preemption_sync_manager = None
+
+    def join():
+        resilience.inject("dist", "init")
+        try:
+            resilience.call_transient_mapped(
+                jax.distributed.initialize, coordinator_address=coord,
+                num_processes=n, process_id=r,
+                markers=resilience.JOIN_TRANSIENT_MARKERS)
+        except resilience.ResilienceError:
+            reset_failed_join()
+            raise
+
+    resilience.retry_call(
+        join, op_name=f"dist.init(rank={r}, coord={coord})",
+        retry_on=(resilience.TransientError,))
     _initialized = True
     return r
 
@@ -91,38 +142,99 @@ def num_workers():
     return jax.process_count()
 
 
+def _guarded(op, tag, body):
+    """Run a collective body under the resilience contract.
+
+    The fault-injection probe (``collective:<op>``) runs *inside* the
+    deadline-wrapped callable, so an injected ``hang`` is cut short by
+    MXTPU_COLLECTIVE_TIMEOUT exactly like a real wedged peer, and an
+    injected ``error`` surfaces as TransientError for the kvstore
+    retry layer.  Fast path: no faults declared and either the
+    deadline is disabled or this is a single-process run — call
+    straight through with zero thread overhead."""
+    import jax
+    from . import resilience
+
+    multi = jax.process_count() > 1
+
+    def entered_body():
+        """The native collective.  On a multi-rank job an in-op
+        transport error is *fatal*, not transient: peers may already
+        have completed the op, and a rank-local retry would enter a
+        fresh collective that pairs with the peers' next one —
+        shape-mismatch crash at best, silently mixed reductions at
+        worst.  Recovery for a broken in-flight collective belongs
+        to the launcher's restart loop, never to an in-place
+        retry."""
+        if not multi:
+            return body()
+        try:
+            return body()
+        except resilience.ResilienceError:
+            raise
+        except (RuntimeError, OSError, ConnectionError) as exc:
+            raise resilience.CollectiveAbortedError(
+                f"collective {op} (tag={tag} "
+                f"rank={jax.process_index()}) failed in-op: {exc}; "
+                "not retried — peers may have completed it, and "
+                "re-entering would desynchronize the ranks (see "
+                "docs/resilience.md)") from exc
+
+    def checked():
+        resilience.inject("collective", op)
+        return entered_body()
+
+    timeout = resilience.collective_timeout()
+    if not resilience.faults_active() and (timeout <= 0 or not multi):
+        return entered_body()
+    return resilience.deadline_call(
+        checked, timeout, op_name=f"collective {op}",
+        detail=f"tag={tag} rank={jax.process_index()} "
+               f"num_workers={jax.process_count()}")
+
+
 def allreduce_sum(value):
     """Sum ``value`` (array or pytree) across all processes.
 
     Results are re-wrapped as jax Arrays (multihost_utils fetches to
     host numpy; callers store these into NDArray._data, whose
-    contract is a device array)."""
+    contract is a device array).  Runs under the
+    MXTPU_COLLECTIVE_TIMEOUT deadline (see _guarded)."""
     import jax
     import jax.numpy as jnp
-    if jax.process_count() == 1:
-        return value
-    from jax.experimental import multihost_utils
 
-    def red(v):
-        gathered = multihost_utils.process_allgather(v)
-        return jnp.asarray(gathered.sum(axis=0))
-    return jax.tree_util.tree_map(red, value)
+    def body():
+        if jax.process_count() == 1:
+            return value
+        from jax.experimental import multihost_utils
+
+        def red(v):
+            gathered = multihost_utils.process_allgather(v)
+            return jnp.asarray(gathered.sum(axis=0))
+        return jax.tree_util.tree_map(red, value)
+    return _guarded("allreduce", "-", body)
 
 
 def broadcast(value, root=0):
     """Every process receives ``root``'s value (array or pytree)."""
     import jax
     import jax.numpy as jnp
-    if jax.process_count() == 1:
-        return value
-    from jax.experimental import multihost_utils
-    out = multihost_utils.broadcast_one_to_all(
-        value, is_source=jax.process_index() == root)
-    return jax.tree_util.tree_map(jnp.asarray, out)
+
+    def body():
+        if jax.process_count() == 1:
+            return value
+        from jax.experimental import multihost_utils
+        out = multihost_utils.broadcast_one_to_all(
+            value, is_source=jax.process_index() == root)
+        return jax.tree_util.tree_map(jnp.asarray, out)
+    return _guarded("broadcast", f"root={root}", body)
 
 
 def barrier(tag="mxtpu_barrier"):
     import jax
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices(tag)
+
+    def body():
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(tag)
+    _guarded("barrier", tag, body)

@@ -256,7 +256,16 @@ class ParameterDict:
     def load(self, filename, ctx=None, allow_missing=False,
              ignore_extra=False, restore_prefix=""):
         from ..ndarray import load as nd_load
-        loaded = nd_load(filename)
+        from ..resilience import CheckpointCorruptError
+        try:
+            loaded = nd_load(filename)
+        except CheckpointCorruptError as exc:
+            # parameter files carry no epoch numbering, so there is
+            # nothing to fall back to — fail with provenance instead
+            # of half-applying a torn file
+            raise CheckpointCorruptError(
+                f"cannot load parameters from {filename}: {exc}"
+            ) from exc
         loaded = {restore_prefix + k: v for k, v in loaded.items()}
         for name, p in self.items():
             if name in loaded:

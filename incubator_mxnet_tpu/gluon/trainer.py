@@ -206,23 +206,25 @@ class Trainer:
 
     def save_states(self, fname):
         import pickle
+
+        from .. import resilience
         if self._fused_active() and self._fstate is not None:
             import numpy as np
             tree = jax.tree_util.tree_map(np.asarray, self._fstate)
-            with open(fname, "wb") as f:
-                pickle.dump({"fused": tree}, f)
+            resilience.atomic_save(
+                fname, lambda f: pickle.dump({"fused": tree}, f))
             return
-        with open(fname, "wb") as f:
-            f.write(self._updater.get_states())
+        resilience.atomic_write_bytes(fname,
+                                      self._updater.get_states())
 
     def load_states(self, fname):
         import pickle
-        with open(fname, "rb") as f:
-            raw = f.read()
-        try:
-            obj = pickle.loads(raw)
-        except Exception:
-            obj = None
+
+        from .. import resilience
+        raw = resilience.read_validated_bytes(fname)
+        # decode under the corruption guard, apply outside it
+        obj = resilience.decode_or_corrupt(
+            fname, lambda: pickle.loads(raw))
         if isinstance(obj, dict) and "fused" in obj:
             if self._fused_update is None:
                 self._init_fused()
@@ -235,4 +237,4 @@ class Trainer:
             self._fstate = jax.tree_util.tree_map(jnp.asarray,
                                                   obj["fused"])
             return
-        self._updater.set_states(raw)
+        self._updater.set_states(obj)

@@ -43,6 +43,23 @@ class KVStore:
         import jax
         return jax.process_count()
 
+    # ------------------------------------------------------- resilience
+    @staticmethod
+    def _dist_retry(fn, op_name, *args):
+        """Run a dist collective with bounded retry on transient
+        errors — injected faults and transport-shaped failures
+        raised *before the op is entered* (call_transient_mapped:
+        grpc UNAVAILABLE, connection resets).  Never retried:
+        deadline expiries (DeadlineExceededError) and in-op failures
+        on a multi-rank job (CollectiveAbortedError, mapped by
+        dist._guarded) — peers may have completed the op, and a
+        rank-local re-entry would pair with their *next* collective;
+        those failures belong to the launcher's restart loop."""
+        from . import resilience
+        return resilience.retry_call(
+            resilience.call_transient_mapped, fn, *args,
+            op_name=op_name, retry_on=(resilience.TransientError,))
+
     def init(self, key, value):
         """Initialize key(s) with initial weight(s)
         (ref: kvstore.py init:96).  Multi-process: rank 0's value is
@@ -55,8 +72,11 @@ class KVStore:
                 continue
             vv = v[0] if isinstance(v, (list, tuple)) else v
             if multi:
-                self._store[k] = NDArray(dist.broadcast(vv._data),
-                                         vv.context)
+                self._store[k] = NDArray(
+                    self._dist_retry(dist.broadcast,
+                                     f"kvstore.init({k}).broadcast",
+                                     vv._data),
+                    vv.context)
             else:
                 self._store[k] = vv.copy()
 
@@ -77,8 +97,11 @@ class KVStore:
                 for extra in vals[1:]:
                     merged += extra.as_in_context(merged.context)
             if multi:
-                merged = NDArray(dist.allreduce_sum(merged._data),
-                                 merged.context)
+                merged = NDArray(
+                    self._dist_retry(dist.allreduce_sum,
+                                     f"kvstore.push({k}).allreduce",
+                                     merged._data),
+                    merged.context)
             if self._updater is not None:
                 if k not in self._store:
                     raise KeyError(f"key {k} not initialized")
@@ -154,16 +177,23 @@ class KVStore:
         pass  # no servers: command surface kept for API parity
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
+        from . import resilience
         if self._updater is None:
             raise ValueError("no updater/optimizer set")
-        with open(fname, "wb") as f:
-            f.write(self._updater.get_states(dump_optimizer))
+        resilience.atomic_write_bytes(
+            fname, self._updater.get_states(dump_optimizer))
 
     def load_optimizer_states(self, fname):
+        from . import resilience
         if self._updater is None:
             raise ValueError("no updater/optimizer set")
-        with open(fname, "rb") as f:
-            self._updater.set_states(f.read())
+        import pickle
+        raw = resilience.read_validated_bytes(fname)
+        # decode under the corruption guard, apply outside it — an
+        # error from applying a well-formed payload is not corruption
+        obj = resilience.decode_or_corrupt(
+            fname, lambda: pickle.loads(raw))
+        self._updater.set_states(obj)
 
     # ------------------------------------------------------------ helpers
     @staticmethod

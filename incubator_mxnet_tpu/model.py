@@ -62,14 +62,102 @@ def split_tagged_params(save_dict):
     return arg_params, aux_params
 
 
-def load_checkpoint(prefix, epoch):
-    """(ref: model.py load_checkpoint) -> (symbol, arg_params, aux_params)."""
+def _checkpoint_epochs(prefix):
+    """(epoch, path) pairs for on-disk ``prefix-N.params`` files,
+    newest epoch first.  The globbed path travels with the epoch so
+    a fallback load opens the file that actually exists — not a
+    ``:04d`` re-derivation that misses unpadded names.  When both a
+    padded and an unpadded file claim the same epoch, the canonical
+    padded one wins everywhere, so weights and their companions
+    (.states) always resolve to the same file."""
+    import glob
     import os
+    best = {}
+    for p in glob.glob(f"{glob.escape(prefix)}-*.params"):
+        tail = os.path.basename(p)[len(os.path.basename(prefix)) + 1:]
+        stem = tail[:-len(".params")]
+        if not stem.isdigit():
+            continue
+        epoch = int(stem)
+        if epoch not in best or p == f"{prefix}-{epoch:04d}.params":
+            best[epoch] = p
+    return sorted(best.items(), reverse=True)
+
+
+def checkpoint_companion_path(prefix, epoch, ext=".states"):
+    """Path of the per-epoch companion file (optimizer ``.states``…)
+    sharing the stem of the params file that actually exists for
+    ``epoch`` — resolved exactly like :func:`load_checkpoint`
+    (canonical padded name first, then the on-disk scan), so the
+    weights and their companion always come from the same stem."""
+    import os
+    want = f"{prefix}-{epoch:04d}.params"
+    if not os.path.exists(want):
+        for cand, path in _checkpoint_epochs(prefix):
+            if cand == epoch:
+                want = path
+                break
+    return want[:-len(".params")] + ext
+
+
+def load_checkpoint(prefix, epoch, fallback=None, return_epoch=False):
+    """(ref: model.py load_checkpoint) -> (symbol, arg_params, aux_params).
+
+    Resilience: when the requested params file is truncated/corrupt
+    (CRC32 sidecar mismatch or undecodable archive — the footprint of
+    a worker killed mid-save before atomic saves existed, or of disk
+    bit-rot), fall back to the newest *earlier* checkpoint that
+    validates, with a warning naming both epochs.  Controlled by
+    ``fallback`` (default: MXTPU_CKPT_FALLBACK env flag, on).
+
+    ``return_epoch=True`` appends the epoch that actually loaded to
+    the tuple — callers pairing params with per-epoch companions
+    (optimizer ``.states``, epoch counters) must use it, or a
+    fallback would mix epoch-N state into epoch-M weights."""
+    import os
+    import warnings
+
+    from .resilience import CheckpointCorruptError
+    from .utils.env import get_env
+    if fallback is None:
+        fallback = get_env("MXTPU_CKPT_FALLBACK")
     symbol = None
     if os.path.exists(f"{prefix}-symbol.json"):
         symbol = sym.load(f"{prefix}-symbol.json")
-    save_dict = nd.load(f"{prefix}-{epoch:04d}.params")
+    effective = epoch
+    want = f"{prefix}-{epoch:04d}.params"
+    if not os.path.exists(want):
+        # requested epoch saved under an unpadded name — resolve it
+        # through the same on-disk scan the fallback uses
+        for cand, cand_path in _checkpoint_epochs(prefix):
+            if cand == epoch:
+                want = cand_path
+                break
+    try:
+        save_dict = nd.load(want)
+    except CheckpointCorruptError as exc:
+        if not fallback:
+            raise
+        for cand, cand_path in _checkpoint_epochs(prefix):
+            if cand >= epoch:
+                continue
+            try:
+                save_dict = nd.load(cand_path)
+            except CheckpointCorruptError:
+                continue
+            warnings.warn(
+                f"checkpoint {prefix}-{epoch:04d}.params is corrupt "
+                f"({exc}); falling back to newest valid epoch "
+                f"{cand}", RuntimeWarning)
+            effective = cand
+            break
+        else:
+            raise CheckpointCorruptError(
+                f"checkpoint {prefix}-{epoch:04d}.params is corrupt "
+                "and no earlier checkpoint validates") from exc
     arg_params, aux_params = split_tagged_params(save_dict)
+    if return_epoch:
+        return symbol, arg_params, aux_params, effective
     return symbol, arg_params, aux_params
 
 
@@ -203,11 +291,17 @@ class FeedForward:
 
     @staticmethod
     def load(prefix, epoch, ctx=None, **kwargs):
-        """(ref: FeedForward.load:389)"""
-        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        """(ref: FeedForward.load:389)
+
+        begin_epoch is the epoch that *actually* loaded: if the
+        requested params were corrupt and the resilience fallback
+        substituted an earlier checkpoint, epoch numbering must
+        follow the weights, not the request."""
+        symbol, arg_params, aux_params, effective = load_checkpoint(
+            prefix, epoch, return_epoch=True)
         return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
                            aux_params=aux_params,
-                           begin_epoch=epoch, **kwargs)
+                           begin_epoch=effective, **kwargs)
 
     @staticmethod
     def create(symbol, X, y=None, ctx=None, num_epoch=None,

@@ -14,7 +14,8 @@ import logging
 from .. import initializer as init_mod
 from .. import optimizer as opt_mod
 from ..initializer import InitDesc
-from ..model import _create_kvstore, save_checkpoint, load_checkpoint
+from ..model import (_create_kvstore, save_checkpoint,
+                     load_checkpoint, checkpoint_companion_path)
 from ..ndarray.ndarray import NDArray
 from .base_module import BaseModule
 
@@ -193,7 +194,22 @@ class Module(BaseModule):
         self.optimizer_initialized = True
         states = getattr(self, "_preload_opt_states", None)
         if states:
-            self.load_optimizer_states(states)
+            from ..resilience import CheckpointCorruptError
+            try:
+                self.load_optimizer_states(states)
+            except (FileNotFoundError, CheckpointCorruptError) as exc:
+                # the params may have come from a fallback epoch
+                # whose .states never existed or was torn; resume
+                # with fresh optimizer state rather than crash:
+                # weights are intact, momentum rebuilds.  Other
+                # OSErrors (EACCES, transient NFS faults) stay loud —
+                # the state likely exists and dropping it would
+                # silently degrade convergence
+                import warnings
+                warnings.warn(
+                    f"optimizer states {states} could not be loaded "
+                    f"({exc}); resuming with freshly initialized "
+                    "optimizer state", RuntimeWarning)
             self._preload_opt_states = None
 
     # ------------------------------------------------------------ mesh
@@ -369,6 +385,7 @@ class Module(BaseModule):
             self.save_optimizer_states(f"{prefix}-{epoch:04d}.states")
 
     def save_optimizer_states(self, fname):
+        from .. import resilience
         assert self.optimizer_initialized
         if self._mesh_step is not None:
             import pickle
@@ -376,40 +393,50 @@ class Module(BaseModule):
             import jax as _jax
             tree = _jax.tree_util.tree_map(_np.asarray,
                                            self._mesh_step.opt_state)
-            with open(fname, "wb") as f:
-                pickle.dump(tree, f)
+            resilience.atomic_save(
+                fname, lambda f: pickle.dump(tree, f))
         elif self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
         else:
-            with open(fname, "wb") as f:
-                f.write(self._updater.get_states())
+            resilience.atomic_write_bytes(
+                fname, self._updater.get_states())
 
     def load_optimizer_states(self, fname):
+        from .. import resilience
         assert self.optimizer_initialized
         if self._mesh_step is not None:
             import pickle
             import jax as _jax
             import jax.numpy as _jnp
-            with open(fname, "rb") as f:
-                tree = pickle.load(f)
+            raw = resilience.read_validated_bytes(fname)
+            tree = resilience.decode_or_corrupt(
+                fname, lambda: pickle.loads(raw))
             self._mesh_step.opt_state = _jax.tree_util.tree_map(
                 _jnp.asarray, tree)
         elif self._update_on_kvstore:
             self._kvstore.load_optimizer_states(fname)
         else:
-            with open(fname, "rb") as f:
-                self._updater.set_states(f.read())
+            import pickle
+            raw = resilience.read_validated_bytes(fname)
+            # decode under the corruption guard, apply outside it
+            obj = resilience.decode_or_corrupt(
+                fname, lambda: pickle.loads(raw))
+            self._updater.set_states(obj)
 
     @staticmethod
     def load(prefix, epoch, load_optimizer_states=False, **kwargs):
         """Load a checkpointed Module; params apply automatically on
         bind() (ref: module.py Module.load)."""
-        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        symbol, arg_params, aux_params, eff = load_checkpoint(
+            prefix, epoch, return_epoch=True)
         mod = Module(symbol, **kwargs)
         mod._preloaded_params = (arg_params, aux_params)
+        # pair optimizer state with the checkpoint that actually
+        # loaded — a corrupt-load fallback may have substituted an
+        # earlier one, possibly under an unpadded filename
         mod._preload_opt_states = \
-            f"{prefix}-{epoch:04d}.states" if load_optimizer_states \
-            else None
+            checkpoint_companion_path(prefix, eff) \
+            if load_optimizer_states else None
         return mod
 
 

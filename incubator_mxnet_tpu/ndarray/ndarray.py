@@ -15,6 +15,7 @@ TPU-native design notes:
 - Autograd recording captures a jax.vjp closure per op (autograd.py).
 """
 import numbers
+import threading
 
 import numpy as np
 
@@ -504,8 +505,11 @@ class NDArray:
 # key) travel as traced leading arguments.  The jitted bwd
 # recomputes the forward (remat) — the eager-mode trade that buys a
 # once-per-shape compile; the compiled training paths (executor /
-# ShardedTrainStep) never come through here.
+# ShardedTrainStep) never come through here.  The cache is unbounded
+# by design: entries are one jit pair per (op, static-params) and
+# real workloads cycle through a handful.
 _STABLE_PAIRS = {}
+_STABLE_PAIRS_LOCK = threading.Lock()
 
 
 def _stable_pair(op, params):
@@ -514,13 +518,18 @@ def _stable_pair(op, params):
         if isinstance(v, (jnp.ndarray, jax.Array, np.ndarray)):
             tensor[k] = v
         else:
-            static[k] = tuple(v) if isinstance(v, list) else v
+            static[k] = v
     tnames = tuple(sorted(tensor))
     try:
         key = (op.name, tuple(sorted(static.items())), tnames)
-        pair = _STABLE_PAIRS.get(key)
+        hash(key)
     except TypeError:        # unhashable param value — no caching
         return None
+    # lock-free on the hit path (the steady state); on a miss, build
+    # the (lazy, uncompiled) jit wrappers outside the lock and let
+    # setdefault pick one winner — concurrent eager calls then share
+    # one jit pair, so the same scan never compiles twice
+    pair = _STABLE_PAIRS.get(key)
     if pair is None:
         fn = op.fn
 
@@ -532,7 +541,8 @@ def _stable_pair(op, params):
             return vjp(cts)
 
         pair = (jax.jit(fwd_raw), jax.jit(bwd_raw))
-        _STABLE_PAIRS[key] = pair
+        with _STABLE_PAIRS_LOCK:
+            pair = _STABLE_PAIRS.setdefault(key, pair)
     jfwd, jbwd = pair
     tvals = tuple(tensor[k] for k in tnames)
     return jfwd, jbwd, tvals
@@ -544,7 +554,11 @@ def imperative_invoke(op, args, kwargs, out=None):
     _prof = _prof_mod._profiler if _prof_mod._profiler.running else None
     if _prof is not None:
         _prof.op_start()
-    params = {k: v for k, v in kwargs.items()
+    # list-valued params become tuples up front so the cache_vjp path
+    # (which must hash them) and the generic eager path hand op.fn
+    # identical types
+    params = {k: (tuple(v) if isinstance(v, list) else v)
+              for k, v in kwargs.items()
               if v is not None and k not in ("name", "ctx")}
     user_params = dict(params)   # pre-internal copy, for get_symbol
     ctx = kwargs.get("ctx")
@@ -790,7 +804,12 @@ def _decode_ext_dtype(k, arr):
 
 def save(fname, data):
     """Save NDArrays: list -> positional, dict -> named (npz-backed;
-    the exact filename is used, no extension is appended)."""
+    the exact filename is used, no extension is appended).
+
+    The write is atomic (temp + fsync + rename, with a CRC32 sidecar
+    — resilience.atomic_save): a reader racing the save, or a crash
+    mid-write, can never leave a partial file at ``fname``."""
+    from ..resilience import atomic_save
     if isinstance(data, NDArray):
         data = [data]
     if isinstance(data, dict):
@@ -798,13 +817,35 @@ def save(fname, data):
     else:
         payload = {f"__pos_{i}": v.asnumpy() for i, v in enumerate(data)}
     payload = dict(_encode_ext_dtype(k, v) for k, v in payload.items())
-    with open(fname, "wb") as f:
-        np.savez(f, **payload)
+    atomic_save(fname, lambda f: np.savez(f, **payload))
 
 
 def load(fname):
-    with np.load(fname, allow_pickle=False) as z:
-        items = dict(_decode_ext_dtype(k, z[k]) for k in z.keys())
+    """Load arrays saved by :func:`save`.
+
+    Validates the CRC32 sidecar when present and converts truncated/
+    undecodable files into CheckpointCorruptError, so callers
+    (model.load_checkpoint) can fall back to an older checkpoint
+    instead of resuming from garbage."""
+    import zipfile
+    from ..resilience import CheckpointCorruptError, validate_or_raise
+    # streaming CRC pass, then np.load from disk: two reads (second
+    # one page-cache warm) but O(1) extra memory — slurping a
+    # multi-GB .params to validate in one pass would double peak
+    # host RAM exactly when the decoded arrays need it
+    validate_or_raise(fname)
+    try:
+        with np.load(fname, allow_pickle=False) as z:
+            items = dict(_decode_ext_dtype(k, z[k]) for k in z.keys())
+    except (zipfile.BadZipFile, ValueError, EOFError) as exc:
+        if isinstance(exc, ValueError) and "allow_pickle" in str(exc):
+            # well-formed archive with object-dtype members: a format
+            # mismatch, not corruption — must not trigger the
+            # fallback-to-older-epoch path
+            raise
+        raise CheckpointCorruptError(
+            f"checkpoint {fname} is not a readable archive "
+            f"({exc})") from exc
     if items and all(k.startswith("__pos_") for k in items):
         return [array(items[f"__pos_{i}"]) for i in range(len(items))]
     return {k: array(v) for k, v in items.items()}

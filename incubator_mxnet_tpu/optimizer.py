@@ -498,7 +498,11 @@ class Updater:
         return pickle.dumps(states)
 
     def set_states(self, states):
-        loaded = pickle.loads(states)
+        # accepts raw pickle bytes or an already-decoded object —
+        # resume paths decode once under the corruption guard and
+        # hand the object over, avoiding a second full decode
+        loaded = pickle.loads(states) \
+            if isinstance(states, (bytes, bytearray)) else states
         if isinstance(loaded, tuple) and len(loaded) == 2 and \
                 isinstance(loaded[1], Optimizer):
             states, self.optimizer = loaded

@@ -1,0 +1,675 @@
+"""Resilience subsystem: retries, deadlines, atomic checkpoints,
+heartbeats, and deterministic fault injection.
+
+The reference framework leans on ps-lite's scheduler for liveness
+tracking and restart (SURVEY §5); the collective replacement here
+(dist.py + tools/launch.py) relaunches dead workers but a real pod
+run dies of subtler failures: a hung collective blocks every rank
+forever, a worker killed mid-``np.savez`` leaves a truncated .params
+file that poisons the resume, and a coordinator that is still
+binding its port fails the join of every late worker.  This module
+is the one place those defenses live:
+
+- :class:`RetryPolicy` / :func:`retry_call` — bounded retry with
+  exponential backoff + jitter (dist.init join, kvstore push/pull).
+- :func:`deadline_call` — run a callable under a wall-clock deadline
+  in a worker thread; on expiry raise :class:`DeadlineExceededError`
+  with a diagnostic instead of hanging (dist collectives).
+- :func:`atomic_save` / :func:`validate_or_raise` — temp-file +
+  fsync + rename checkpoint writes with a CRC32 sidecar, so a reader
+  never observes a partial file and a corrupt one is *detected*
+  rather than silently loaded.
+- :func:`start_heartbeat` — a daemon thread touching a per-worker
+  file so the launcher can tell *hung* from *crashed* workers.
+- Deterministic fault injection via ``MXTPU_FAULT_SPEC`` so every
+  path above is testable on CPU: ``scope:op:nth:kind`` (e.g.
+  ``collective:allreduce:2:hang``, ``checkpoint:save:1:truncate``);
+  see docs/resilience.md for the grammar.
+
+Everything here is stdlib-only and import-light so dist workers can
+use it before jax is up.
+"""
+import os
+import random
+import tempfile
+import threading
+import time
+import warnings
+import zlib
+
+from .utils.env import get_env
+
+__all__ = ["ResilienceError", "TransientError", "DeadlineExceededError",
+           "CollectiveAbortedError",
+           "CheckpointCorruptError", "RetryPolicy", "retry_call",
+           "deadline_call", "call_transient_mapped", "TRANSIENT_MARKERS",
+           "JOIN_TRANSIENT_MARKERS", "decode_or_corrupt",
+           "parse_fault_spec", "faults_active",
+           "fault_for", "inject", "reset_faults", "atomic_save",
+           "atomic_write_bytes", "checksum_path", "verify_checkpoint",
+           "validate_or_raise", "read_validated_bytes",
+           "start_heartbeat", "stop_heartbeat",
+           "collective_timeout"]
+
+
+# ---------------------------------------------------------------------------
+# errors
+# ---------------------------------------------------------------------------
+
+
+class ResilienceError(RuntimeError):
+    """Base class for resilience-layer failures."""
+
+
+class TransientError(ResilienceError):
+    """A failure worth retrying (transport hiccup, injected fault)."""
+
+
+class DeadlineExceededError(ResilienceError):
+    """An operation ran past its wall-clock deadline."""
+
+
+class CollectiveAbortedError(ResilienceError):
+    """A multi-rank collective failed after being entered.
+
+    Never retried in place: peers may have completed the op, and a
+    rank-local re-entry would pair with their *next* collective.
+    Recovery is the launcher restart loop's job."""
+
+
+class CheckpointCorruptError(ResilienceError, IOError):
+    """A checkpoint file failed checksum / decode validation.
+
+    Subclasses IOError so legacy ``except IOError`` checkpoint
+    handling still catches it."""
+
+
+# ---------------------------------------------------------------------------
+# retry with exponential backoff + jitter
+# ---------------------------------------------------------------------------
+
+
+class RetryPolicy:
+    """Bounded exponential backoff: delay_i = min(base * 2**i, max),
+    each widened by up to ``jitter`` fraction (decorrelates workers
+    hammering a recovering coordinator).  ``seed`` makes the jitter
+    sequence deterministic (tests)."""
+
+    def __init__(self, max_retries=None, base_delay=None,
+                 max_delay=None, jitter=None, seed=None):
+        self.max_retries = max_retries if max_retries is not None \
+            else get_env("MXTPU_RETRY_MAX")
+        self.base_delay = base_delay if base_delay is not None \
+            else get_env("MXTPU_RETRY_BASE_DELAY_S")
+        self.max_delay = max_delay if max_delay is not None \
+            else get_env("MXTPU_RETRY_MAX_DELAY_S")
+        self.jitter = jitter if jitter is not None \
+            else get_env("MXTPU_RETRY_JITTER")
+        self._rng = random.Random(seed)
+
+    def delays(self):
+        """The backoff schedule: one delay per allowed retry."""
+        out = []
+        for i in range(self.max_retries):
+            d = min(self.base_delay * (2 ** i), self.max_delay)
+            if self.jitter:
+                d += d * self.jitter * self._rng.random()
+            out.append(d)
+        return out
+
+
+# grpc-status / errno phrases that mark a failure as transport-shaped
+# (DNS hiccup, peer restarting) rather than a permanent
+# misconfiguration.  Deliberately excludes deadline/timeout phrases:
+# for a *collective*, a transport deadline means some peers already
+# left the op, and re-entering it would desynchronize the ranks.
+TRANSIENT_MARKERS = ("UNAVAILABLE", "CONNECT", "REFUSED",
+                     "UNREACHABLE", "TEMPORAR")
+
+# The coordinator *join* is not a collective — nothing desyncs by
+# retrying it — and a join deadline usually just means rank 0 is
+# still binding its port, so there timeouts are worth retrying too.
+JOIN_TRANSIENT_MARKERS = TRANSIENT_MARKERS + (
+    "DEADLINE_EXCEEDED", "TIMED OUT", "TIMEOUT")
+
+
+def call_transient_mapped(fn, *args, markers=TRANSIENT_MARKERS,
+                          **kwargs):
+    """Call ``fn``, re-raising transport-shaped failures (matching
+    ``markers``) as :class:`TransientError` so :func:`retry_call` can
+    retry them.
+
+    Other resilience errors pass through untouched — in particular a
+    :class:`DeadlineExceededError` must never be re-mapped and
+    retried (re-entering a collective some peers already left would
+    desynchronize the job), and neither must a permanent
+    misconfiguration (it should fail on the first attempt)."""
+    try:
+        return fn(*args, **kwargs)
+    except ResilienceError:
+        raise
+    except ConnectionError as exc:
+        raise TransientError(str(exc)) from exc
+    except (RuntimeError, OSError) as exc:
+        # includes TimeoutError: whether a timeout counts as
+        # transient is exactly what ``markers`` decides
+        msg = (str(exc) or type(exc).__name__).upper()
+        if any(m in msg for m in markers):
+            raise TransientError(str(exc)) from exc
+        raise
+
+
+def retry_call(fn, *args, policy=None, retry_on=(TransientError,),
+               op_name=None, **kwargs):
+    """Call ``fn`` with bounded retries on ``retry_on`` exceptions.
+
+    Backoff follows ``policy`` (default: env-configured
+    :class:`RetryPolicy`, built lazily on the *first failure* — the
+    no-failure steady state, e.g. kvstore.push per key per step,
+    pays no policy construction, env reads, or RNG seeding).  The
+    final failure re-raises the original exception so caller
+    except-clauses keep working; each retry emits a warning naming
+    the op and attempt."""
+    delays = None
+    attempt = 0
+    while True:
+        try:
+            return fn(*args, **kwargs)
+        except retry_on as exc:
+            if delays is None:
+                delays = (policy or RetryPolicy()).delays()
+            if attempt >= len(delays):
+                raise
+            name = op_name or getattr(fn, "__name__", "call")
+            warnings.warn(
+                f"{name} failed (attempt {attempt + 1}/"
+                f"{len(delays) + 1}: {exc}); retrying in "
+                f"{delays[attempt]:.2f}s", RuntimeWarning)
+            time.sleep(delays[attempt])
+            attempt += 1
+
+
+# ---------------------------------------------------------------------------
+# deadlines
+# ---------------------------------------------------------------------------
+
+
+class _DeadlineWorker:
+    """A reusable daemon thread that runs one callable at a time.
+
+    Reuse keeps :func:`deadline_call` off the thread-creation path —
+    per-step collectives (kvstore.push per key) run under a deadline,
+    so spawning a fresh thread per call would tax the training hot
+    loop.  A worker whose callable blew its deadline is *abandoned*
+    (its thread is wedged in the hung call and dies with the
+    process); only workers that finished are returned to the idle
+    pool."""
+
+    def __init__(self):
+        self._job = None
+        self._ready = threading.Event()
+        t = threading.Thread(target=self._loop, daemon=True,
+                             name="mxtpu-deadline-worker")
+        t.start()
+
+    def _loop(self):
+        while True:
+            self._ready.wait()
+            self._ready.clear()
+            fn, box, done = self._job
+            self._job = None
+            try:
+                box["result"] = fn()
+            except BaseException as exc:    # noqa: B036 — re-raised below
+                box["error"] = exc
+            done.set()
+
+    def run(self, fn, timeout):
+        """Returns (result box, finished-within-deadline flag)."""
+        box, done = {}, threading.Event()
+        self._job = (fn, box, done)
+        self._ready.set()
+        return box, done.wait(timeout)
+
+
+_DL_LOCK = threading.Lock()
+_DL_IDLE = []                  # finished workers, available for reuse
+
+
+def deadline_call(fn, timeout, op_name="op", detail=""):
+    """Run ``fn()`` with a wall-clock deadline.
+
+    The callable runs on a (reused) daemon worker thread; if it does
+    not finish within ``timeout`` seconds a
+    :class:`DeadlineExceededError` is raised with
+    ``op_name``/``detail`` in the message.  The worker abandoned on
+    expiry is left to die with the process — there is no portable way
+    to kill a thread blocked in a native collective, which is exactly
+    why the *process* monitor (launch.py heartbeats) exists above
+    this layer.  ``timeout <= 0`` disables the wrap."""
+    if not timeout or timeout <= 0:
+        return fn()
+    with _DL_LOCK:
+        worker = _DL_IDLE.pop() if _DL_IDLE else _DeadlineWorker()
+    box, finished = worker.run(fn, timeout)
+    if not finished:
+        raise DeadlineExceededError(
+            f"{op_name} did not complete within {timeout}s "
+            f"({detail}); the operation may be hung on a dead or "
+            "desynchronized peer — see docs/resilience.md")
+    with _DL_LOCK:
+        _DL_IDLE.append(worker)
+    if "error" in box:
+        raise box["error"]
+    return box.get("result")
+
+
+def collective_timeout():
+    """Deadline for dist collectives (MXTPU_COLLECTIVE_TIMEOUT,
+    seconds; 0 disables)."""
+    return get_env("MXTPU_COLLECTIVE_TIMEOUT")
+
+
+# ---------------------------------------------------------------------------
+# deterministic fault injection
+# ---------------------------------------------------------------------------
+
+_FAULT_LOCK = threading.Lock()
+_FAULT_CACHE = (None, ())          # (raw env string, parsed specs)
+_FAULT_COUNTS = {}                 # (scope, op) -> calls seen
+
+_FAULT_KINDS = ("hang", "error", "truncate", "corrupt")
+
+
+def parse_fault_spec(raw):
+    """Parse ``MXTPU_FAULT_SPEC``: comma-separated
+    ``scope:op:nth:kind`` entries — *nth* is the 1-based call index
+    the fault fires on (or ``*`` for every call), *kind* one of
+    hang | error | truncate | corrupt.  Raises ValueError with the
+    offending entry on bad grammar."""
+    specs = []
+    for entry in (raw or "").split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.split(":")
+        if len(parts) != 4:
+            raise ValueError(
+                f"bad fault spec {entry!r}: want scope:op:nth:kind")
+        scope, op, nth, kind = parts
+        if not scope or not op:
+            raise ValueError(
+                f"bad fault spec {entry!r}: empty scope or op")
+        if kind not in _FAULT_KINDS:
+            raise ValueError(
+                f"bad fault spec {entry!r}: kind {kind!r} not in "
+                f"{_FAULT_KINDS}")
+        if kind in ("truncate", "corrupt") and scope != "checkpoint":
+            # data-path kinds only have an effect where a data file
+            # is written; accepting them elsewhere would validate a
+            # spec that injects nothing
+            raise ValueError(
+                f"bad fault spec {entry!r}: kind {kind!r} only "
+                "applies to the 'checkpoint' scope")
+        if nth != "*":
+            try:
+                nth = int(nth)
+            except ValueError:
+                raise ValueError(
+                    f"bad fault spec {entry!r}: nth must be a "
+                    "1-based integer or '*'") from None
+            if nth < 1:
+                raise ValueError(
+                    f"bad fault spec {entry!r}: nth must be >= 1")
+        specs.append((scope, op, nth, kind))
+    return specs
+
+
+def _specs():
+    global _FAULT_CACHE
+    raw = get_env("MXTPU_FAULT_SPEC")
+    if _FAULT_CACHE[0] != raw:
+        _FAULT_CACHE = (raw, tuple(parse_fault_spec(raw)))
+    return _FAULT_CACHE[1]
+
+
+def faults_active():
+    """True when MXTPU_FAULT_SPEC declares at least one fault."""
+    return bool(_specs())
+
+
+def fault_for(scope, op):
+    """Advance the (scope, op) call counter and return the fault
+    kind due on this call, or None.  Counting only happens while a
+    spec is set, so the production fast path costs one env read."""
+    specs = _specs()
+    if not specs:
+        return None
+    with _FAULT_LOCK:
+        n = _FAULT_COUNTS.get((scope, op), 0) + 1
+        _FAULT_COUNTS[(scope, op)] = n
+    for s_scope, s_op, s_nth, s_kind in specs:
+        if s_scope == scope and s_op == op and \
+                (s_nth == "*" or s_nth == n):
+            return s_kind
+    return None
+
+
+def reset_faults():
+    """Clear injection call counters (test isolation)."""
+    with _FAULT_LOCK:
+        _FAULT_COUNTS.clear()
+
+
+def inject(scope, op):
+    """Fire any fault due for this (scope, op) call.
+
+    ``error`` raises :class:`TransientError`; ``hang`` sleeps for
+    MXTPU_FAULT_HANG_S (run this *inside* a deadline-wrapped callable
+    so the deadline, not the sleep, decides the outcome);
+    ``truncate``/``corrupt`` are returned for data-path callers
+    (atomic_save) to apply."""
+    kind = fault_for(scope, op)
+    if kind == "error":
+        raise TransientError(
+            f"injected transient error for {scope}:{op}")
+    if kind == "hang":
+        time.sleep(get_env("MXTPU_FAULT_HANG_S"))
+        return None
+    return kind
+
+
+# ---------------------------------------------------------------------------
+# atomic, checksummed checkpoint io
+# ---------------------------------------------------------------------------
+
+
+def checksum_path(path):
+    """Sidecar path holding "crc32_hex size" for ``path``."""
+    return path + ".crc32"
+
+
+def _read_sidecar(side):
+    """Parse a sidecar file ("crc32hex size") -> (crc, size).
+    Raises ValueError/OSError on a malformed or unreadable one —
+    the single definition of the sidecar format on the read side."""
+    with open(side, "rb") as f:
+        want_crc, want_size = f.read().split()
+    return int(want_crc, 16), int(want_size)
+
+
+def _file_crc(path):
+    crc, size = 0, 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            crc = zlib.crc32(chunk, crc)
+            size += len(chunk)
+    return crc & 0xFFFFFFFF, size
+
+
+def _write_tmp(path, writer):
+    """``writer(fileobj)`` into a same-directory fsynced temp file;
+    returns the temp path (cleaned up on writer failure)."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=d,
+                               prefix=os.path.basename(path) + ".tmp.")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            writer(f)
+            f.flush()
+            os.fsync(f.fileno())
+    except BaseException:
+        os.unlink(tmp)
+        raise
+    return tmp
+
+
+def _fsync_dir(path):
+    """fsync the directory holding ``path`` so a just-committed
+    rename/unlink survives power loss, not only process death.  Some
+    filesystems refuse dir fsync — then rename ordering is all we
+    get, which still covers every process-crash point."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _replace_with_bytes(path, data, sync_dir=True):
+    """Write ``data`` to ``path`` via temp + fsync + rename (+ dir
+    fsync unless ``sync_dir=False`` — heartbeats skip it: their
+    freshness is mtime-based and moot after a power loss)."""
+    tmp = _write_tmp(path, lambda f: f.write(data))
+    try:
+        os.replace(tmp, path)
+    except BaseException:
+        os.unlink(tmp)
+        raise
+    if sync_dir:
+        _fsync_dir(path)
+
+
+def atomic_save(path, writer):
+    """Atomically write a checkpoint: ``writer(fileobj)`` produces
+    the payload into a same-directory temp file, which is fsynced and
+    renamed over ``path`` only once complete — a concurrent reader
+    sees either the old file or the new one, never a torn write.  The
+    stale CRC32+size sidecar (``path.crc32``) is removed *before* the
+    data rename and the fresh one written right after, so no crash
+    point pairs a data file with a mismatched sidecar (which
+    validate_or_raise would reject, blocking resume from a file that
+    is in fact complete): a crash before the data rename leaves the
+    old data sidecar-less but intact, one between rename and sidecar
+    write leaves the new data sidecar-less but complete — both load,
+    since a missing sidecar passes validation.  The containing
+    directory is fsynced after the data rename, so the same
+    crash-point analysis holds across power loss, not just process
+    death.
+
+    Injection point ``checkpoint:save`` — ``truncate`` cuts the temp
+    file in half and ``corrupt`` flips a byte *after* the sidecar
+    checksum is taken, deterministically producing the torn/bit-rot
+    states the load-side fallback defends against."""
+    kind = inject("checkpoint", "save")
+    tmp = _write_tmp(path, writer)
+    try:
+        crc, size = _file_crc(tmp)
+        if kind == "truncate":
+            os.truncate(tmp, max(1, size // 2))
+        elif kind == "corrupt":
+            with open(tmp, "r+b") as f:
+                first = f.read(1)
+                f.seek(0)
+                f.write(bytes([first[0] ^ 0xFF]) if first else b"\xff")
+        try:
+            os.unlink(checksum_path(path))
+        except FileNotFoundError:
+            pass
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    # commit the unlink+rename before the new sidecar can land: a
+    # power loss must never resurrect the old sidecar next to the
+    # new data (= spurious CRC veto on a complete file)
+    _fsync_dir(path)
+    _replace_with_bytes(checksum_path(path),
+                        f"{crc:08x} {size}\n".encode())
+
+
+def atomic_write_bytes(path, data):
+    """Atomic checksummed write of a bytes payload."""
+    atomic_save(path, lambda f: f.write(data))
+
+
+def verify_checkpoint(path, require_sidecar=False):
+    """True when ``path`` exists and matches its CRC32 sidecar.
+
+    A missing sidecar passes (pre-resilience checkpoints stay
+    loadable) unless ``require_sidecar``."""
+    if not os.path.exists(path):
+        return False
+    side = checksum_path(path)
+    if not os.path.exists(side):
+        return not require_sidecar
+    try:
+        want_crc, want_size = _read_sidecar(side)
+        crc, size = _file_crc(path)
+        return crc == want_crc and size == want_size
+    except (ValueError, OSError):
+        return False
+
+
+def read_validated_bytes(path):
+    """Read ``path`` once and validate the bytes against the CRC32
+    sidecar when one exists (missing sidecar passes, as everywhere).
+
+    Single-pass replacement for ``validate_or_raise`` + re-open, for
+    payloads the caller decodes from memory anyway (pickle optimizer
+    states).  Big array checkpoints (``nd.load``) instead stream the
+    CRC and decode from disk — holding a multi-GB raw payload would
+    double peak host RAM exactly when the decoded arrays need it.
+
+    A mismatch is re-read once before being declared corruption: a
+    concurrent atomic_save can land its rename between our data read
+    and sidecar read, pairing old bytes with the new sidecar — the
+    second read sees a settled pair, so a real corruption still
+    raises and a mid-save race never vetoes a healthy file."""
+    for attempt in (0, 1):
+        with open(path, "rb") as f:
+            data = f.read()
+        side = checksum_path(path)
+        if not os.path.exists(side):
+            return data
+        try:
+            want_crc, want_size = _read_sidecar(side)
+            ok = (zlib.crc32(data) & 0xFFFFFFFF) == want_crc \
+                and len(data) == want_size
+        except (ValueError, OSError):
+            ok = False
+        if ok:
+            return data
+    raise CheckpointCorruptError(
+        f"checkpoint {path} failed CRC32 validation "
+        f"(truncated or corrupt; sidecar {checksum_path(path)})")
+
+
+def decode_or_corrupt(fname, fn):
+    """Run a *pure decode* step ``fn()`` (pickle.loads, archive
+    parse — no application side effects), mapping any failure to
+    :class:`CheckpointCorruptError`.
+
+    Legacy pre-sidecar files have no CRC to validate against, so a
+    truncated one passes :func:`validate_or_raise` and only fails
+    here — resume guards catching IOError/CheckpointCorruptError
+    must see that failure too, not a raw pickle error.  A corrupt
+    pickle stream can raise nearly anything (UnpicklingError,
+    EOFError, AttributeError, ImportError, KeyError…), which is why
+    ``fn`` must not also *apply* the payload: an error from applying
+    a well-formed object (optimizer-config mismatch in set_states)
+    is not corruption and must stay loud, or the states-degrade path
+    would silently discard a healthy file."""
+    try:
+        return fn()
+    except ResilienceError:
+        raise
+    except Exception as exc:
+        raise CheckpointCorruptError(
+            f"checkpoint {fname} failed to decode ({exc}); "
+            "truncated or corrupt") from exc
+
+
+def validate_or_raise(path):
+    """Raise :class:`CheckpointCorruptError` when ``path`` fails its
+    sidecar check (missing sidecars pass, as in verify_checkpoint).
+    A mismatch is re-checked once — see read_validated_bytes for the
+    concurrent-save race this absorbs."""
+    if os.path.exists(path) and not verify_checkpoint(path) \
+            and not verify_checkpoint(path):
+        raise CheckpointCorruptError(
+            f"checkpoint {path} failed CRC32 validation (truncated "
+            "or corrupt; sidecar " + checksum_path(path) + ")")
+
+
+# ---------------------------------------------------------------------------
+# worker heartbeats
+# ---------------------------------------------------------------------------
+
+_HB_LOCK = threading.Lock()
+_HB_STATE = {"thread": None, "stop": None, "path": None}
+
+
+def _beat(path):
+    """One heartbeat: atomically refresh ``path`` with a timestamp
+    (rename, so the monitor never reads a partial write)."""
+    _replace_with_bytes(path, f"{time.time():.3f}\n".encode(),
+                        sync_dir=False)
+
+
+def start_heartbeat(path=None, interval=None):
+    """Start the per-worker heartbeat daemon thread (idempotent for
+    the same path; a new path stops the old beat and re-targets).
+
+    Touches ``path`` (default MXTPU_HEARTBEAT_FILE; unset → no-op)
+    every ``interval`` seconds (default MXTPU_HEARTBEAT_INTERVAL).
+    Because it is a plain Python daemon thread it keeps beating while
+    the main thread blocks in a GIL-releasing collective, but stops
+    when the process is truly wedged (SIGSTOP, C-level deadlock
+    holding the GIL) — which is exactly the distinction the launcher
+    monitor needs.  Injection point ``heartbeat:beat`` with ``hang``
+    silences the beat (simulated wedge) without stopping the worker.
+
+    Returns the heartbeat path, or None when disabled."""
+    path = path or get_env("MXTPU_HEARTBEAT_FILE") or None
+    if path is None:
+        return None
+    interval = interval if interval is not None \
+        else get_env("MXTPU_HEARTBEAT_INTERVAL")
+    with _HB_LOCK:
+        if _HB_STATE["thread"] is not None and \
+                _HB_STATE["thread"].is_alive():
+            if _HB_STATE["path"] == path:
+                return path
+            # re-targeted (fresh per-attempt file after a dist
+            # re-init): stop the old beat so the monitor never
+            # watches a path nobody refreshes
+            _HB_STATE["stop"].set()
+        stop = threading.Event()
+
+        def loop():
+            while not stop.is_set():
+                if fault_for("heartbeat", "beat") == "hang":
+                    return      # beat silenced: monitor sees a wedge
+                try:
+                    _beat(path)
+                except OSError:
+                    pass        # dir vanished mid-teardown: harmless
+                stop.wait(interval)
+
+        t = threading.Thread(target=loop, daemon=True,
+                             name="mxtpu-heartbeat")
+        _HB_STATE.update(thread=t, stop=stop, path=path)
+        t.start()
+        return path
+
+
+def stop_heartbeat():
+    """Stop the heartbeat thread (tests / clean shutdown)."""
+    with _HB_LOCK:
+        if _HB_STATE["stop"] is not None:
+            _HB_STATE["stop"].set()
+        t = _HB_STATE["thread"]
+        _HB_STATE.update(thread=None, stop=None, path=None)
+    if t is not None and t.is_alive():
+        t.join(timeout=5)

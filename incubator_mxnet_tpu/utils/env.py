@@ -71,3 +71,36 @@ register_env("MXTPU_KVSTORE_BIGARRAY_BOUND", int, 1000000,
 register_env("MXTPU_CPU_WORKER_NTHREADS", int, 4,
              "host worker threads for data pipeline")
 register_env("MXTPU_SEED", int, 0, "global RNG seed at import")
+
+# Resilience layer (resilience.py; docs/resilience.md).
+register_env("MXTPU_COLLECTIVE_TIMEOUT", float, 600.0,
+             "wall-clock deadline (s) for dist collectives; a hung "
+             "allreduce/broadcast/barrier raises a diagnostic "
+             "DeadlineExceededError instead of blocking forever; "
+             "0 disables")
+register_env("MXTPU_RETRY_MAX", int, 4,
+             "max retries for transient dist failures "
+             "(coordinator join, kvstore push/pull)")
+register_env("MXTPU_RETRY_BASE_DELAY_S", float, 0.1,
+             "first backoff delay (s); doubles per retry")
+register_env("MXTPU_RETRY_MAX_DELAY_S", float, 5.0,
+             "backoff delay cap (s)")
+register_env("MXTPU_RETRY_JITTER", float, 0.25,
+             "fraction of each backoff delay added as random jitter")
+register_env("MXTPU_FAULT_SPEC", str, "",
+             "deterministic fault injection: comma-separated "
+             "scope:op:nth:kind entries, e.g. "
+             "'collective:allreduce:2:hang,checkpoint:save:1:truncate'")
+register_env("MXTPU_FAULT_HANG_S", float, 3600.0,
+             "how long an injected 'hang' fault sleeps")
+register_env("MXTPU_HEARTBEAT_FILE", str, "",
+             "path the worker's heartbeat thread refreshes (set per "
+             "worker by tools/launch.py; empty disables heartbeats)")
+register_env("MXTPU_HEARTBEAT_INTERVAL", float, 2.0,
+             "seconds between per-worker heartbeat file refreshes")
+register_env("MXTPU_HEARTBEAT_TIMEOUT", float, 60.0,
+             "launcher kills a worker whose heartbeat is staler than "
+             "this (s); 0 disables hung-worker detection")
+register_env("MXTPU_CKPT_FALLBACK", bool, True,
+             "on corrupt/truncated checkpoint load, fall back to the "
+             "newest earlier checkpoint that validates")

@@ -1,0 +1,711 @@
+"""Resilience layer (resilience.py + its threading through dist/
+kvstore/model/launch.py): backoff schedules, fault-spec parsing,
+deadline-guarded collectives, atomic checksummed checkpoint saves,
+corrupt-load fallback, and hung-worker heartbeat detection — all on
+CPU, all via deterministic fault injection (docs/resilience.md)."""
+import os
+import subprocess
+import sys
+import threading
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import resilience as rz
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv("MXTPU_FAULT_SPEC", raising=False)
+    rz.reset_faults()
+    yield
+    rz.reset_faults()
+
+
+# ---------------------------------------------------------------- policy
+def test_backoff_schedule_exponential_capped():
+    p = rz.RetryPolicy(max_retries=5, base_delay=0.5, max_delay=3.0,
+                       jitter=0)
+    assert p.delays() == [0.5, 1.0, 2.0, 3.0, 3.0]
+
+
+def test_backoff_jitter_bounded_and_seed_deterministic():
+    mk = lambda: rz.RetryPolicy(max_retries=4, base_delay=1.0,
+                                max_delay=8.0, jitter=0.5, seed=7)
+    a, b = mk().delays(), mk().delays()
+    assert a == b                       # seeded: reproducible
+    for base, d in zip([1.0, 2.0, 4.0, 8.0], a):
+        assert base <= d <= base * 1.5  # jitter widens, never shrinks
+
+
+def test_retry_call_retries_then_succeeds_and_exhausts():
+    calls = []
+
+    def flaky(fail_n):
+        calls.append(1)
+        if len(calls) <= fail_n:
+            raise rz.TransientError("flake")
+        return "ok"
+
+    pol = rz.RetryPolicy(max_retries=3, base_delay=0.001,
+                         max_delay=0.001, jitter=0)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        assert rz.retry_call(flaky, 2, policy=pol) == "ok"
+    assert len(calls) == 3
+
+    calls.clear()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        with pytest.raises(rz.TransientError):
+            rz.retry_call(flaky, 99, policy=pol)
+    assert len(calls) == 4              # 1 try + 3 retries
+
+
+def test_transient_mapping_markers_split():
+    def grpc_deadline():
+        raise RuntimeError("DEADLINE_EXCEEDED: barrier timed out")
+
+    # collective default: a transport deadline is NOT transient
+    # (re-entering the op would desynchronize the ranks)
+    with pytest.raises(RuntimeError, match="DEADLINE_EXCEEDED"):
+        rz.call_transient_mapped(grpc_deadline)
+    # coordinator join: the same failure is worth retrying
+    with pytest.raises(rz.TransientError):
+        rz.call_transient_mapped(grpc_deadline,
+                                 markers=rz.JOIN_TRANSIENT_MARKERS)
+
+    def unavailable():
+        raise RuntimeError("UNAVAILABLE: connection reset")
+
+    with pytest.raises(rz.TransientError):
+        rz.call_transient_mapped(unavailable)
+
+    def misconfig():
+        raise RuntimeError("invalid process id 7")
+
+    with pytest.raises(RuntimeError, match="invalid process id"):
+        rz.call_transient_mapped(misconfig,
+                                 markers=rz.JOIN_TRANSIENT_MARKERS)
+    # resilience errors always pass through unmapped
+    with pytest.raises(rz.DeadlineExceededError):
+        rz.call_transient_mapped(rz.deadline_call,
+                                 lambda: time.sleep(5), 0.1)
+
+
+def test_retry_call_does_not_catch_other_errors():
+    def boom():
+        raise ValueError("not transient")
+
+    with pytest.raises(ValueError):
+        rz.retry_call(boom, policy=rz.RetryPolicy(3, 0.001, 0.001, 0))
+
+
+# ---------------------------------------------------------------- deadline
+def test_deadline_call_passthrough_and_timeout():
+    assert rz.deadline_call(lambda: 5, 10, op_name="x") == 5
+    assert rz.deadline_call(lambda: 5, 0, op_name="x") == 5  # disabled
+    t0 = time.time()
+    with pytest.raises(rz.DeadlineExceededError, match="myop.*det=1"):
+        rz.deadline_call(lambda: time.sleep(30), 0.2,
+                         op_name="myop", detail="det=1")
+    assert time.time() - t0 < 5
+
+
+def test_deadline_call_propagates_worker_exception():
+    def boom():
+        raise KeyError("inner")
+
+    with pytest.raises(KeyError):
+        rz.deadline_call(boom, 5, op_name="x")
+
+
+# ---------------------------------------------------------------- faults
+def test_fault_spec_rejects_data_kinds_outside_checkpoint_scope():
+    with pytest.raises(ValueError, match="only.*checkpoint"):
+        rz.parse_fault_spec("collective:allreduce:1:truncate")
+    with pytest.raises(ValueError, match="only.*checkpoint"):
+        rz.parse_fault_spec("heartbeat:beat:1:corrupt")
+
+
+def test_fault_spec_parsing():
+    specs = rz.parse_fault_spec(
+        "collective:allreduce:2:hang, checkpoint:save:1:truncate,"
+        "heartbeat:beat:*:hang")
+    assert specs == [("collective", "allreduce", 2, "hang"),
+                     ("checkpoint", "save", 1, "truncate"),
+                     ("heartbeat", "beat", "*", "hang")]
+    assert rz.parse_fault_spec("") == []
+    for bad in ("nope", "a:b:c", "a:b:0:hang", "a:b:x:hang",
+                "a:b:1:explode", ":op:1:hang"):
+        with pytest.raises(ValueError, match="fault spec"):
+            rz.parse_fault_spec(bad)
+
+
+def test_fault_counters_fire_on_nth_call(monkeypatch):
+    monkeypatch.setenv("MXTPU_FAULT_SPEC",
+                       "kv:push:2:error,kv:pull:*:error")
+    rz.reset_faults()
+    assert rz.fault_for("kv", "push") is None          # call 1
+    assert rz.fault_for("kv", "push") == "error"       # call 2
+    assert rz.fault_for("kv", "push") is None          # call 3
+    for _ in range(3):
+        assert rz.fault_for("kv", "pull") == "error"   # every call
+    assert rz.fault_for("other", "push") is None
+
+
+def test_injected_error_raises_transient(monkeypatch):
+    monkeypatch.setenv("MXTPU_FAULT_SPEC", "dist:init:1:error")
+    rz.reset_faults()
+    with pytest.raises(rz.TransientError, match="dist:init"):
+        rz.inject("dist", "init")
+    rz.inject("dist", "init")           # call 2: clean
+
+
+# ------------------------------------------------------- dist collectives
+def test_injected_collective_hang_times_out_with_diagnostics(
+        monkeypatch):
+    """Acceptance (a): a hung collective surfaces a timeout error
+    naming op, tag and rank rather than blocking forever."""
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("MXTPU_FAULT_SPEC", "collective:allreduce:1:hang")
+    monkeypatch.setenv("MXTPU_COLLECTIVE_TIMEOUT", "0.5")
+    monkeypatch.setenv("MXTPU_FAULT_HANG_S", "3")
+    rz.reset_faults()
+    t0 = time.time()
+    with pytest.raises(rz.DeadlineExceededError) as exc:
+        mx.dist.allreduce_sum(jnp.ones((3,)))
+    assert time.time() - t0 < 5
+    msg = str(exc.value)
+    assert "allreduce" in msg and "rank=0" in msg and "tag=" in msg
+    # only the 1st call was poisoned; the op itself still works
+    out = mx.dist.allreduce_sum(jnp.ones((3,)))
+    np.testing.assert_allclose(np.asarray(out), 1.0)
+
+
+def test_injected_barrier_hang_names_tag(monkeypatch):
+    monkeypatch.setenv("MXTPU_FAULT_SPEC", "collective:barrier:1:hang")
+    monkeypatch.setenv("MXTPU_COLLECTIVE_TIMEOUT", "0.4")
+    monkeypatch.setenv("MXTPU_FAULT_HANG_S", "3")
+    rz.reset_faults()
+    with pytest.raises(rz.DeadlineExceededError,
+                       match="barrier.*tag=mytag"):
+        mx.dist.barrier("mytag")
+
+
+def test_kvstore_push_retries_injected_transient(monkeypatch):
+    """kvstore collective transport absorbs a transient dist error
+    via retry_call (the kvstore.push/init code path)."""
+    from incubator_mxnet_tpu.kvstore import KVStore
+
+    monkeypatch.setenv("MXTPU_FAULT_SPEC", "collective:broadcast:1:error")
+    monkeypatch.setenv("MXTPU_RETRY_BASE_DELAY_S", "0.001")
+    monkeypatch.setenv("MXTPU_RETRY_MAX_DELAY_S", "0.001")
+    rz.reset_faults()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        out = KVStore._dist_retry(mx.dist.broadcast,
+                                  "kvstore.init.broadcast",
+                                  np.ones((2,), np.float32))
+    np.testing.assert_allclose(np.asarray(out), 1.0)
+
+
+def test_join_retry_resets_jax_global_state(monkeypatch):
+    """A transient coordinator-join failure must clear jax's
+    distributed global state before the retry: jax sets
+    global_state.client before connect(), so without the reset every
+    retry dies on 'should only be called once' instead of
+    re-attempting the join."""
+    import jax
+    from jax._src.distributed import global_state
+    from incubator_mxnet_tpu import dist
+
+    monkeypatch.setenv("MXTPU_RETRY_BASE_DELAY_S", "0.001")
+    monkeypatch.setenv("MXTPU_RETRY_MAX_DELAY_S", "0.001")
+    attempts = []
+
+    def fake_initialize(**kwargs):
+        attempts.append(kwargs["process_id"])
+        if len(attempts) < 3:
+            # mimic jax: leave globals populated, then fail connect
+            global_state.client = object()
+            raise RuntimeError("UNAVAILABLE: failed to connect to "
+                               "coordinator")
+        assert global_state.client is None     # reset happened
+
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        fake_initialize)
+    monkeypatch.setattr(dist, "_initialized", False)
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            r = dist.init(coordinator_address="127.0.0.1:1",
+                          num_workers_=2, rank_=1)
+        assert r == 1 and len(attempts) == 3
+    finally:
+        monkeypatch.setattr(dist, "_initialized", False)
+        global_state.client = None
+        rz.stop_heartbeat()
+
+
+def test_multirank_in_op_failure_is_never_retried(monkeypatch):
+    """An in-op transport error on a multi-rank collective surfaces
+    as CollectiveAbortedError and is NOT retried — peers may have
+    completed the op, and a rank-local re-entry would pair with
+    their next collective (rank desync)."""
+    import jax
+    from jax.experimental import multihost_utils
+    from incubator_mxnet_tpu.kvstore import KVStore
+
+    calls = []
+
+    def failing_allgather(v):
+        calls.append(1)
+        raise RuntimeError("UNAVAILABLE: connection reset by peer")
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(multihost_utils, "process_allgather",
+                        failing_allgather)
+    with pytest.raises(rz.CollectiveAbortedError,
+                       match="not retried"):
+        KVStore._dist_retry(mx.dist.allreduce_sum,
+                            "kvstore.push(w).allreduce",
+                            np.ones((2,), np.float32))
+    # UNAVAILABLE would count as transient pre-entry; in-op it must
+    # produce exactly one attempt
+    assert len(calls) == 1
+
+
+# ------------------------------------------------------- atomic ckpt io
+def test_atomic_save_reader_never_sees_partial_file(tmp_path):
+    """Acceptance (c): while a slow multi-chunk save is in flight the
+    destination path stays absent; whenever it is visible it is the
+    complete payload, never a torn prefix."""
+    path = str(tmp_path / "big.bin")
+    chunks = 20
+
+    def slow_writer(f):
+        for _ in range(chunks):
+            f.write(os.urandom(1 << 14))
+            f.flush()
+            time.sleep(0.01)
+
+    observations = []
+    done = threading.Event()
+
+    def poller():
+        while not done.is_set():
+            try:
+                observations.append(os.path.getsize(path))
+            except OSError:
+                pass                    # not visible yet
+            time.sleep(0.002)
+
+    t = threading.Thread(target=poller)
+    t.start()
+    try:
+        rz.atomic_save(path, slow_writer)
+    finally:
+        done.set()
+        t.join()
+    # every observation of the path (if any raced in before done) was
+    # of the full payload — a reader can never see a partial file
+    assert all(sz == chunks * (1 << 14) for sz in observations)
+    assert rz.verify_checkpoint(path, require_sidecar=True)
+    assert os.path.getsize(path) == chunks * (1 << 14)
+    # no stray temp files left behind
+    leftovers = [p for p in os.listdir(tmp_path) if ".tmp." in p]
+    assert leftovers == []
+
+
+def test_atomic_save_failure_leaves_no_temp(tmp_path):
+    path = str(tmp_path / "x.bin")
+
+    def bad_writer(f):
+        raise RuntimeError("disk on fire")
+
+    with pytest.raises(RuntimeError):
+        rz.atomic_save(path, bad_writer)
+    assert os.listdir(tmp_path) == []
+
+
+def test_nd_save_writes_sidecar_and_detects_corruption(tmp_path):
+    path = str(tmp_path / "w.params")
+    mx.nd.save(path, {"a": mx.nd.ones((4, 4))})
+    assert os.path.exists(rz.checksum_path(path))
+    assert rz.verify_checkpoint(path, require_sidecar=True)
+    with open(path, "r+b") as f:
+        f.truncate(os.path.getsize(path) // 2)
+    with pytest.raises(rz.CheckpointCorruptError):
+        mx.nd.load(path)
+
+
+def test_legacy_checkpoint_without_sidecar_still_loads(tmp_path):
+    path = str(tmp_path / "legacy.params")
+    mx.nd.save(path, {"a": mx.nd.ones((2,))})
+    os.unlink(rz.checksum_path(path))
+    out = mx.nd.load(path)
+    np.testing.assert_allclose(out["a"].asnumpy(), 1.0)
+
+
+def test_truncated_checkpoint_falls_back_to_last_good(
+        tmp_path, monkeypatch):
+    """Acceptance (b): a truncated checkpoint load falls back to the
+    newest valid earlier checkpoint, with a warning."""
+    prefix = str(tmp_path / "model")
+    mx.model.save_checkpoint(prefix, 1, None,
+                             {"w": mx.nd.full((3,), 1.0)}, {})
+    mx.model.save_checkpoint(prefix, 2, None,
+                             {"w": mx.nd.full((3,), 2.0)}, {})
+    # epoch 3's save is torn by an injected fault
+    monkeypatch.setenv("MXTPU_FAULT_SPEC", "checkpoint:save:1:truncate")
+    rz.reset_faults()
+    mx.model.save_checkpoint(prefix, 3, None,
+                             {"w": mx.nd.full((3,), 3.0)}, {})
+    monkeypatch.delenv("MXTPU_FAULT_SPEC")
+    assert not rz.verify_checkpoint(prefix + "-0003.params")
+
+    with pytest.warns(RuntimeWarning, match="falling back.*epoch 2"):
+        _, arg, _ = mx.model.load_checkpoint(prefix, 3)
+    np.testing.assert_allclose(arg["w"].asnumpy(), 2.0)
+
+    # fallback disabled -> the corruption surfaces
+    with pytest.raises(rz.CheckpointCorruptError):
+        mx.model.load_checkpoint(prefix, 3, fallback=False)
+
+
+def test_corrupt_with_no_valid_predecessor_raises(tmp_path,
+                                                  monkeypatch):
+    prefix = str(tmp_path / "solo")
+    monkeypatch.setenv("MXTPU_FAULT_SPEC", "checkpoint:save:1:corrupt")
+    rz.reset_faults()
+    mx.model.save_checkpoint(prefix, 1, None,
+                             {"w": mx.nd.ones((2,))}, {})
+    monkeypatch.delenv("MXTPU_FAULT_SPEC")
+    with pytest.raises(rz.CheckpointCorruptError,
+                       match="no earlier checkpoint"):
+        mx.model.load_checkpoint(prefix, 1)
+
+
+def test_optimizer_states_atomic_and_validated(tmp_path):
+    kv = mx.kvstore.create("local")
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+    fname = str(tmp_path / "opt.states")
+    kv.save_optimizer_states(fname)
+    assert rz.verify_checkpoint(fname, require_sidecar=True)
+    kv.load_optimizer_states(fname)
+    with open(fname, "r+b") as f:
+        f.truncate(2)
+    with pytest.raises(rz.CheckpointCorruptError):
+        kv.load_optimizer_states(fname)
+    # legacy pre-sidecar truncated states (no .crc32 to fail against)
+    # must still surface as CheckpointCorruptError, not a raw pickle
+    # error
+    os.unlink(rz.checksum_path(fname))
+    with pytest.raises(rz.CheckpointCorruptError, match="decode"):
+        kv.load_optimizer_states(fname)
+    # legacy bit-flip (not truncation): corrupt pickles raise
+    # arbitrary exception types, all of which must map to
+    # CheckpointCorruptError so degrade paths can catch them
+    kv.save_optimizer_states(fname)
+    os.unlink(rz.checksum_path(fname))
+    with open(fname, "r+b") as f:
+        f.seek(os.path.getsize(fname) // 2)
+        b = f.read(1)
+        f.seek(-1, 1)
+        f.write(bytes([b[0] ^ 0xFF]))
+    with pytest.raises(rz.CheckpointCorruptError):
+        kv.load_optimizer_states(fname)
+
+
+def test_load_checkpoint_reports_effective_epoch(tmp_path,
+                                                 monkeypatch):
+    prefix = str(tmp_path / "m")
+    mx.model.save_checkpoint(prefix, 1, None,
+                             {"w": mx.nd.full((2,), 1.0)}, {})
+    monkeypatch.setenv("MXTPU_FAULT_SPEC", "checkpoint:save:1:truncate")
+    rz.reset_faults()
+    mx.model.save_checkpoint(prefix, 2, None,
+                             {"w": mx.nd.full((2,), 2.0)}, {})
+    monkeypatch.delenv("MXTPU_FAULT_SPEC")
+    with pytest.warns(RuntimeWarning, match="falling back"):
+        _, arg, _, eff = mx.model.load_checkpoint(prefix, 2,
+                                                  return_epoch=True)
+    assert eff == 1                 # callers pair .states with this
+    np.testing.assert_allclose(arg["w"].asnumpy(), 1.0)
+    _, _, _, eff = mx.model.load_checkpoint(prefix, 1,
+                                            return_epoch=True)
+    assert eff == 1
+
+
+def test_fallback_survives_unpadded_checkpoint_names(tmp_path,
+                                                     monkeypatch):
+    """An unpadded (hand-renamed / external) params file in the
+    fallback scan must be opened by its real path, not a re-derived
+    :04d name — and must not abort the fallback to older epochs."""
+    prefix = str(tmp_path / "m")
+    mx.model.save_checkpoint(prefix, 6, None,
+                             {"w": mx.nd.full((2,), 6.0)}, {})
+    # epoch 7 saved under an unpadded name
+    mx.nd.save(prefix + "-7.params", {"arg:w": mx.nd.full((2,), 7.0)})
+    monkeypatch.setenv("MXTPU_FAULT_SPEC", "checkpoint:save:1:truncate")
+    rz.reset_faults()
+    mx.model.save_checkpoint(prefix, 8, None,
+                             {"w": mx.nd.full((2,), 8.0)}, {})
+    monkeypatch.delenv("MXTPU_FAULT_SPEC")
+    with pytest.warns(RuntimeWarning, match="falling back.*epoch 7"):
+        _, arg, _, eff = mx.model.load_checkpoint(prefix, 8,
+                                                  return_epoch=True)
+    assert eff == 7
+    np.testing.assert_allclose(arg["w"].asnumpy(), 7.0)
+    # direct request of the unpadded epoch resolves through the same
+    # on-disk scan
+    _, arg, _ = mx.model.load_checkpoint(prefix, 7)
+    np.testing.assert_allclose(arg["w"].asnumpy(), 7.0)
+
+
+def test_padded_name_wins_epoch_ties_for_params_and_companions(
+        tmp_path):
+    """When a padded and an unpadded file both claim an epoch, every
+    resolver (primary load, fallback scan, companion path) picks the
+    canonical padded one — weights and .states must share a stem."""
+    prefix = str(tmp_path / "ck")
+    mx.model.save_checkpoint(prefix, 7, None,
+                             {"w": mx.nd.full((2,), 1.0)}, {})
+    mx.nd.save(prefix + "-7.params", {"arg:w": mx.nd.full((2,), 2.0)})
+    _, arg, _ = mx.model.load_checkpoint(prefix, 7)
+    np.testing.assert_allclose(arg["w"].asnumpy(), 1.0)
+    assert mx.model.checkpoint_companion_path(prefix, 7) == \
+        prefix + "-0007.states"
+    assert mx.model._checkpoint_epochs(prefix) == \
+        [(7, prefix + "-0007.params")]
+
+
+def test_object_dtype_archive_is_not_corruption(tmp_path):
+    """A well-formed npz with object-dtype members is a format
+    mismatch and must stay loud — not CheckpointCorruptError, which
+    would silently fall back to an older epoch."""
+    p = str(tmp_path / "obj.params")
+    with open(p, "wb") as f:
+        np.savez(f, a=np.array([{"x": 1}], dtype=object))
+    with pytest.raises(ValueError, match="allow_pickle"):
+        mx.nd.load(p)
+
+
+def test_feedforward_load_begin_epoch_follows_fallback(tmp_path,
+                                                       monkeypatch):
+    """FeedForward.load must number epochs from the checkpoint that
+    actually loaded, not the one requested — or a post-fallback fit()
+    would attribute saves/LR schedules to epochs the substituted
+    weights never trained through."""
+    prefix = str(tmp_path / "ff")
+    mx.model.save_checkpoint(prefix, 1, None,
+                             {"w": mx.nd.full((2,), 1.0)}, {})
+    monkeypatch.setenv("MXTPU_FAULT_SPEC", "checkpoint:save:1:truncate")
+    rz.reset_faults()
+    mx.model.save_checkpoint(prefix, 2, None,
+                             {"w": mx.nd.full((2,), 2.0)}, {})
+    monkeypatch.delenv("MXTPU_FAULT_SPEC")
+    with pytest.warns(RuntimeWarning, match="falling back"):
+        model = mx.model.FeedForward.load(prefix, 2)
+    assert model.begin_epoch == 1
+    np.testing.assert_allclose(model.arg_params["w"].asnumpy(), 1.0)
+
+
+def test_crash_before_sidecar_write_leaves_loadable_file(tmp_path,
+                                                         monkeypatch):
+    """A save killed between the data rename and the sidecar write
+    must leave a loadable checkpoint: the stale sidecar is removed
+    before the rename, and a missing sidecar passes validation — the
+    renamed data file is complete (it was fsynced as a temp), so
+    rejecting it would block resume for nothing."""
+    path = str(tmp_path / "re.params")
+    mx.nd.save(path, {"w": mx.nd.full((2,), 1.0)})
+
+    def die_before_sidecar(p, data):
+        raise OSError("simulated crash before sidecar commit")
+
+    monkeypatch.setattr(rz, "_replace_with_bytes", die_before_sidecar)
+    with pytest.raises(OSError, match="simulated crash"):
+        mx.nd.save(path, {"w": mx.nd.full((2,), 2.0)})
+    monkeypatch.undo()
+
+    assert not os.path.exists(rz.checksum_path(path))
+    out = mx.nd.load(path)      # new, complete data — no stale-CRC veto
+    np.testing.assert_allclose(out["w"].asnumpy(), 2.0)
+
+
+def test_parameter_dict_load_reports_corruption(tmp_path):
+    from incubator_mxnet_tpu.gluon.parameter import ParameterDict
+
+    fname = str(tmp_path / "p.params")
+    pd = ParameterDict()
+    p = pd.get("w", shape=(2,))
+    p.initialize()
+    pd.save(fname)
+    with open(fname, "r+b") as f:
+        f.truncate(4)
+    with pytest.raises(rz.CheckpointCorruptError, match="p.params"):
+        ParameterDict().load(fname)
+
+
+# ------------------------------------------------------- heartbeats
+def test_module_load_degrades_to_fresh_optimizer_state(tmp_path,
+                                                       monkeypatch):
+    """Module.load(load_optimizer_states=True) whose paired .states
+    file is missing (fallback epoch never had one, or retention
+    removed it) resumes with fresh optimizer state and a warning,
+    not a crashed resume."""
+    prefix = str(tmp_path / "mm")
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=2, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    X = np.random.RandomState(0).randn(8, 3).astype("float32")
+    y = (X.sum(1) > 0).astype("float32")
+    it = mx.io.NDArrayIter(X, y, batch_size=4,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(net, label_names=["softmax_label"])
+    mod.fit(it, num_epoch=1,
+            epoch_end_callback=mx.callback.do_checkpoint(prefix))
+    assert not os.path.exists(prefix + "-0001.states")
+    loaded = mx.mod.Module.load(prefix, 1, load_optimizer_states=True)
+    loaded.bind(data_shapes=it.provide_data,
+                label_shapes=it.provide_label)
+    with pytest.warns(RuntimeWarning, match="freshly initialized"):
+        loaded.init_optimizer()
+    assert loaded._preload_opt_states is None
+
+
+def test_read_validated_bytes_single_pass(tmp_path):
+    """read_validated_bytes returns the payload in one disk pass and
+    still vetoes a post-save truncation."""
+    p = str(tmp_path / "b.params")
+    rz.atomic_write_bytes(p, b"payload-bytes")
+    assert rz.read_validated_bytes(p) == b"payload-bytes"
+    with open(p, "r+b") as f:
+        f.truncate(3)
+    with pytest.raises(rz.CheckpointCorruptError):
+        rz.read_validated_bytes(p)
+
+
+def test_heartbeat_thread_refreshes_file(tmp_path):
+    path = str(tmp_path / "hb")
+    try:
+        assert rz.start_heartbeat(path, interval=0.05) == path
+        deadline = time.time() + 5
+        while not os.path.exists(path) and time.time() < deadline:
+            time.sleep(0.01)
+        m1 = os.path.getmtime(path)
+        deadline = time.time() + 5
+        while os.path.getmtime(path) == m1 and time.time() < deadline:
+            time.sleep(0.01)
+        assert os.path.getmtime(path) > m1
+    finally:
+        rz.stop_heartbeat()
+
+
+def test_heartbeat_retargets_on_new_path(tmp_path):
+    """start_heartbeat with a different path must stop the old beat
+    and refresh the new file — or a monitor watching the new path
+    would kill a healthy worker."""
+    a, b = str(tmp_path / "hb-a"), str(tmp_path / "hb-b")
+    try:
+        assert rz.start_heartbeat(a, interval=0.05) == a
+        assert rz.start_heartbeat(b, interval=0.05) == b
+        deadline = time.time() + 5
+        while not os.path.exists(b) and time.time() < deadline:
+            time.sleep(0.02)
+        assert os.path.exists(b)
+    finally:
+        rz.stop_heartbeat()
+
+
+def test_heartbeat_disabled_without_path(monkeypatch):
+    monkeypatch.delenv("MXTPU_HEARTBEAT_FILE", raising=False)
+    assert rz.start_heartbeat() is None
+
+
+def test_launch_kills_hung_worker_via_heartbeat(tmp_path):
+    """A worker that beats once then goes silent (but never exits) is
+    detected as hung, killed, and fails the job — instead of blocking
+    the launcher forever."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    script = (
+        "import os, time\n"
+        "hb = os.environ['MXTPU_HEARTBEAT_FILE']\n"
+        "open(hb, 'w').write('beat')\n"      # one beat, then wedge
+        "if os.environ['MXTPU_WORKER_RANK'] == '0':\n"
+        "    time.sleep(600)\n"
+        "time.sleep(600)\n")
+    t0 = time.time()
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--heartbeat-timeout", "1",
+         "--heartbeat-interval", "0.2", "--",
+         sys.executable, "-c", script],
+        capture_output=True, text=True, timeout=60, env=env, cwd=REPO)
+    assert r.returncode != 0, r.stdout + r.stderr
+    assert "hung" in r.stderr, r.stderr[-2000:]
+    assert time.time() - t0 < 50
+
+
+def test_launch_rejects_interval_exceeding_timeout():
+    """A heartbeat interval the timeout can't accommodate would make
+    the monitor kill every healthy worker — launch refuses it."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "1", "--heartbeat-timeout", "1",
+         "--heartbeat-interval", "2", "--",
+         sys.executable, "-c", "pass"],
+        capture_output=True, text=True, timeout=60, cwd=REPO)
+    assert r.returncode != 0
+    assert "at least twice" in r.stderr
+
+
+def test_launch_heartbeat_not_required_for_fast_jobs(tmp_path):
+    """Workers that never write a heartbeat file (non-framework
+    commands) are unmonitored and finish normally."""
+    env = dict(os.environ)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--heartbeat-timeout", "1",
+         "--heartbeat-interval", "0.2", "--",
+         sys.executable, "-c", "print('fine')"],
+        capture_output=True, text=True, timeout=60, env=env, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+# ------------------------------------------------------- misc satellites
+def test_log_metrics_callback_closes_writer(tmp_path):
+    from incubator_mxnet_tpu.contrib.tensorboard import (
+        LogMetricsCallback, _JsonlWriter)
+
+    w = _JsonlWriter(str(tmp_path))
+    with LogMetricsCallback(str(tmp_path), summary_writer=w) as cb:
+        assert cb.writer is w
+    assert cb.writer is None
+    assert not w._f.closed          # caller-owned: not closed for them
+    w.close()
+
+    cb2 = LogMetricsCallback(str(tmp_path))
+    inner = cb2.writer
+    cb2.close()
+    if isinstance(inner, _JsonlWriter):
+        assert inner._f.closed      # owned: released
+
+
+def test_list_env_exported_via_star():
+    import incubator_mxnet_tpu as pkg
+
+    assert "list_env" in pkg.__all__
+    ns = {}
+    exec("from incubator_mxnet_tpu import *", ns)
+    assert callable(ns["list_env"])
+    assert "MXTPU_COLLECTIVE_TIMEOUT" in pkg.list_env()

@@ -121,20 +121,66 @@ def _remote_command(args, rank, coord, attempt, cmd):
             f"{assigns} exec {prog}")
 
 
-def _run_once(spawners):
+def _env_float(name, default):
+    """Forgiving env-float read matching the package registry's
+    semantics (MXNET_ prefix fallback, bad value -> default) without
+    importing the package into the launcher process."""
+    for key in (name, "MXNET_" + name[len("MXTPU_"):]):
+        raw = os.environ.get(key)
+        if raw is not None:
+            try:
+                return float(raw)
+            except ValueError:
+                pass
+    return default
+
+
+def _hb_path(hb_dir, attempt, rank):
+    """Heartbeat file for one worker of one attempt (fresh file per
+    attempt: a restart must not inherit the dead attempt's mtimes)."""
+    return os.path.join(hb_dir, f"hb-{attempt}-{rank}")
+
+
+def _run_once(spawners, hb_files=None, hb_timeout=0):
     """Start every worker; first nonzero exit tears the job down (a
     crashing worker mid-collective leaves peers blocked forever — the
-    reference's ps-lite scheduler dies the same way)."""
+    reference's ps-lite scheduler dies the same way).
+
+    Heartbeat monitoring (hb_files: rank -> path, hb_timeout > 0)
+    closes the gap poll() cannot see: a *hung* worker — wedged in a
+    dead collective or a C-level deadlock — never exits, so the only
+    liveness signal is its heartbeat file going stale.  Such a worker
+    is killed, which turns the hang into an ordinary failure the
+    --max-restarts loop already handles.  A worker that never created
+    its file is not monitored (it may be a pre-dist warmup phase or a
+    command that does not call dist.init())."""
     procs = []
     try:
         for spawn in spawners:
             procs.append(spawn())
         rc = 0
         pending = dict(enumerate(procs))
+        killed = set()        # ranks already killed as hung: one
+                              # SIGKILL + one log line each, then we
+                              # just wait for the reap
         while pending and rc == 0:
+            now = time.time()
             for r, p in list(pending.items()):
                 code = p.poll()
                 if code is None:
+                    if hb_timeout > 0 and hb_files and r in hb_files \
+                            and r not in killed:
+                        try:
+                            age = now - os.path.getmtime(hb_files[r])
+                        except OSError:
+                            continue    # no heartbeat yet: unmonitored
+                        if age > hb_timeout:
+                            print(f"launch.py: worker {r} hung (no "
+                                  f"heartbeat for {age:.0f}s > "
+                                  f"{hb_timeout:.0f}s); killing it",
+                                  file=sys.stderr)
+                            p.kill()
+                            killed.add(r)
                     continue
                 del pending[r]
                 if code != 0:
@@ -179,6 +225,15 @@ def main():
                     metavar="KEY=VALUE",
                     help="extra env var to propagate to every worker "
                     "(repeatable)")
+    ap.add_argument("--heartbeat-timeout", type=float,
+                    default=_env_float("MXTPU_HEARTBEAT_TIMEOUT", 60.0),
+                    help="local mode: kill a worker whose heartbeat "
+                    "file (written by dist.init's beat thread) is "
+                    "staler than this many seconds — distinguishes "
+                    "hung workers from crashed ones; 0 disables")
+    ap.add_argument("--heartbeat-interval", type=float,
+                    default=_env_float("MXTPU_HEARTBEAT_INTERVAL", 2.0),
+                    help="seconds between worker heartbeat refreshes")
     ap.add_argument("--max-restarts", type=int, default=0,
                     help="elastic mode: relaunch the whole job up to "
                     "N times after a worker failure (workers resume "
@@ -195,12 +250,35 @@ def main():
     if cmd and cmd[0] == "--":
         cmd = cmd[1:]
 
+    if 0 < args.heartbeat_timeout < 2 * args.heartbeat_interval:
+        # a worker sleeping one interval must never look hung — the
+        # monitor would SIGKILL every healthy worker and burn the
+        # whole --max-restarts budget on a fine job
+        ap.error(
+            f"--heartbeat-timeout ({args.heartbeat_timeout:g}s) must "
+            f"be at least twice --heartbeat-interval "
+            f"({args.heartbeat_interval:g}s), or 0 to disable")
+
+    hb_dir = None
+    if args.launcher == "local" and args.heartbeat_timeout > 0:
+        # heartbeat files only work where the monitor shares a
+        # filesystem with the workers — local mode; ssh-mode hosts
+        # would need a side channel (documented de-scope,
+        # docs/resilience.md)
+        import tempfile
+        hb_dir = tempfile.mkdtemp(prefix="mxtpu_hb_")
+
     if args.launcher == "local":
         def make_spawners(coord, attempt):
             spawners = []
             for r in range(args.num_workers):
                 env = dict(os.environ)
                 env.update(_worker_env(args, r, coord, attempt))
+                if hb_dir is not None:
+                    env["MXTPU_HEARTBEAT_FILE"] = \
+                        _hb_path(hb_dir, attempt, r)
+                    env["MXTPU_HEARTBEAT_INTERVAL"] = \
+                        str(args.heartbeat_interval)
 
                 def spawn(env=env):
                     return subprocess.Popen(cmd, env=env)
@@ -266,16 +344,28 @@ def main():
             print(_remote_command(args, r, coord, 0, cmd))
         return 0
 
-    coord = coord_for(0)
-    rc = _run_once(make_spawners(coord, 0))
-    for attempt in range(1, args.max_restarts + 1):
-        if rc == 0:
-            break
-        print(f"launch.py: restarting job (attempt {attempt}/"
-              f"{args.max_restarts}); workers should resume from "
-              "their last checkpoint", file=sys.stderr)
-        rc = _run_once(make_spawners(coord_for(attempt), attempt))
-    return rc
+    def hb_files(attempt):
+        if hb_dir is None:
+            return None
+        return {r: _hb_path(hb_dir, attempt, r)
+                for r in range(args.num_workers)}
+
+    try:
+        coord = coord_for(0)
+        rc = _run_once(make_spawners(coord, 0), hb_files(0),
+                       args.heartbeat_timeout)
+        for attempt in range(1, args.max_restarts + 1):
+            if rc == 0:
+                break
+            print(f"launch.py: restarting job (attempt {attempt}/"
+                  f"{args.max_restarts}); workers should resume from "
+                  "their last checkpoint", file=sys.stderr)
+            rc = _run_once(make_spawners(coord_for(attempt), attempt),
+                           hb_files(attempt), args.heartbeat_timeout)
+        return rc
+    finally:
+        if hb_dir is not None:
+            shutil.rmtree(hb_dir, ignore_errors=True)
 
 
 if __name__ == "__main__":
