@@ -32,6 +32,21 @@ CKPT_MODULES = (
     "incubator_mxnet_tpu/module/",
 )
 
+# Input-pipeline modules.  In these, a bare ``queue.get()`` with no
+# timeout is forbidden: a producer that died or wedged leaves the
+# consumer blocked forever, which on a TPU pod looks like a hung job
+# the heartbeat monitor cannot distinguish from real work.  All
+# prefetch-queue reads must go through io.io._bounded_get (deadline +
+# dead-thread detection -> typed DataPipelineError).
+DATA_QUEUE_DIRS = (
+    "incubator_mxnet_tpu/io/",
+    "incubator_mxnet_tpu/gluon/data/",
+)
+
+# MXTPU_-prefixed tokens that are NOT environment variables (log
+# markers etc.) — exempt from the env-var documentation check.
+NON_ENV_TOKENS = {"MXTPU_KILLED"}
+
 
 def _is_binary_write_open(node):
     """True for ``open(..., "wb"/"wb+"/...)`` calls."""
@@ -97,6 +112,7 @@ def check_file(path):
     in_ckpt_module = any(
         posix.endswith(m) or (m.endswith("/") and m in posix)
         for m in CKPT_MODULES)
+    in_data_queue_module = any(d in posix for d in DATA_QUEUE_DIRS)
 
     for node in ast.walk(tree):
         if in_ckpt_module and _is_binary_write_open(node):
@@ -105,6 +121,18 @@ def check_file(path):
                 "checkpoint-writing module — use resilience."
                 "atomic_save/atomic_write_bytes so saves are "
                 "atomic and checksummed")
+        if in_data_queue_module and isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "get" \
+                and not node.args and not node.keywords:
+            # zero-arg .get() is queue-shaped (dict.get needs a key):
+            # an unbounded wait that hangs the consumer forever when
+            # the producer dies
+            problems.append(
+                f"{path}:{node.lineno}: unbounded queue .get() in "
+                "input-pipeline module — use io.io._bounded_get "
+                "(MXTPU_DATA_TIMEOUT deadline + dead-producer "
+                "detection) or pass a timeout")
         if (not is_init and isinstance(node, ast.ImportFrom)
                 and any(a.name == "*" for a in node.names)):
             # __init__.py wildcard re-exports are the namespace
@@ -140,6 +168,74 @@ def check_file(path):
     return problems
 
 
+def _load_env_registry():
+    """Load utils/env.py standalone (no package import — that would
+    pull in jax) and return the registered flag names."""
+    import importlib.util
+    env_py = Path("incubator_mxnet_tpu/utils/env.py")
+    if not env_py.exists():
+        return None
+    spec = importlib.util.spec_from_file_location("_mxtpu_env_lint",
+                                                  env_py)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return set(mod.list_env())
+
+
+def check_env_vars(files):
+    """Every ``MXTPU_*`` env var referenced in code must be
+    documented in docs/env_vars.md, and every flag read through the
+    typed registry (``get_env(...)``) must be declared there
+    (``register_env``) so ``mx.list_env()`` stays complete."""
+    import re
+    docs = Path("docs/env_vars.md")
+    if not docs.exists():
+        return []
+    problems = []
+    registry = _load_env_registry()
+    token_re = re.compile(r"MXTPU_[A-Z][A-Z0-9_]*")
+    # compare whole tokens, not substrings: an undocumented
+    # MXTPU_DATA must not ride on documented MXTPU_DATA_TIMEOUT
+    documented = set(token_re.findall(docs.read_text()))
+    for path in files:
+        posix = path.as_posix()
+        if not (posix.startswith("incubator_mxnet_tpu")
+                or posix.startswith("tools")):
+            continue
+        src = path.read_text()
+        try:
+            tree = ast.parse(src)
+        except SyntaxError:
+            continue        # reported by check_file
+        lines = src.splitlines()
+        for i, line in enumerate(lines, 1):
+            for tok in token_re.findall(line):
+                if tok in NON_ENV_TOKENS or tok in documented:
+                    continue
+                problems.append(
+                    f"{path}:{i}: env var {tok} is not documented "
+                    "in docs/env_vars.md")
+        if registry is None:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str) \
+                    and node.args[0].value.startswith("MXTPU_"):
+                fn = node.func
+                name = fn.id if isinstance(fn, ast.Name) else \
+                    fn.attr if isinstance(fn, ast.Attribute) else ""
+                if name == "get_env" \
+                        and node.args[0].value not in registry:
+                    problems.append(
+                        f"{path}:{node.lineno}: get_env("
+                        f"{node.args[0].value!r}) is not declared "
+                        "via register_env in utils/env.py (list_env "
+                        "would miss it)")
+    # de-dup repeated hits of the same token on adjacent lines
+    return sorted(set(problems))
+
+
 def main(argv):
     roots = argv or DEFAULT_PATHS
     files = []
@@ -152,6 +248,7 @@ def main(argv):
     problems = []
     for f in files:
         problems.extend(check_file(f))
+    problems.extend(check_env_vars(files))
     for p in problems:
         print(p)
     print(f"lint: {len(files)} files, {len(problems)} problems")

@@ -38,12 +38,28 @@ import numpy as np
 
 from ...ndarray import array as nd_array
 from ...ndarray.ndarray import NDArray
+from ...resilience import DataPipelineError, inject
 from ...utils.concurrent import bounded_window as _bounded_window
+from ...utils.env import get_env
 from .sampler import BatchSampler, RandomSampler, SequentialSampler
 
 __all__ = ["DataLoader", "default_batchify_fn"]
 
 _SHM_PREFIX = "mxtpu_dl_"
+
+
+def _sweep_segments(prefix):
+    """Unlink every /dev/shm segment under ``prefix`` (leaked by a
+    dead worker or an abandoned iteration); returns the count."""
+    import glob as _glob
+    removed = 0
+    for path in _glob.glob("/dev/shm/" + prefix + "*"):
+        try:
+            os.unlink(path)
+            removed += 1
+        except OSError:
+            pass
+    return removed
 
 
 def default_batchify_fn(data):
@@ -204,9 +220,14 @@ def _worker_init(dataset, batchify_fn, prefix, accel):
     _worker_accel = accel
 
 
-def _worker_fn(indices):
+def _worker_fn(indices, token):
+    """Build one batch under a per-task shm prefix: when the parent
+    declares this task lost (worker died holding it), it can sweep
+    exactly this task's segments — completed batches from the same
+    worker keep theirs."""
+    inject("dataloader", "worker")
     batch = _worker_batchify([_worker_dataset[i] for i in indices])
-    return _to_shm(batch, _worker_prefix)
+    return _to_shm(batch, _worker_prefix + token + "_")
 
 
 class DataLoader:
@@ -231,12 +252,56 @@ class DataLoader:
         self._batchify_fn = batchify_fn
         self._num_workers = max(0, num_workers)
         self._thread_pool = thread_pool
+        self._batches_served = 0
+        self._epoch_rng = None
+        self._resume = None
+
+    # ------------------------------------------------- resumable state
+    def state_dict(self):
+        """Checkpointable position: batches served this epoch + the
+        numpy RNG state snapshotted when the epoch's iteration began
+        (the sampler's shuffle source), so a restore replays the same
+        sampler order and skips exactly the served batches."""
+        if self._resume is not None:
+            return dict(self._resume)    # armed but not yet applied
+        rng = self._epoch_rng if self._epoch_rng is not None \
+            else np.random.get_state()
+        return {"type": "DataLoader",
+                "batches_served": self._batches_served,
+                "epoch_rng": rng}
+
+    def load_state_dict(self, state):
+        """Arm a resume: the next ``iter()`` restores the saved RNG
+        state, regenerates the identical sampler order, and skips the
+        already-served index batches without loading their data."""
+        if state.get("type") != "DataLoader":
+            raise ValueError(
+                f"state_dict type {state.get('type')!r} does not "
+                "match DataLoader")
+        self._resume = dict(state)
+
+    def _sampler_batches(self, skip):
+        for j, idxs in enumerate(self._batch_sampler):
+            if j < skip:
+                continue
+            yield idxs
 
     def __iter__(self):
+        resume, self._resume = self._resume, None
+        if resume is not None:
+            np.random.set_state(resume["epoch_rng"])
+            skip = int(resume["batches_served"])
+        else:
+            skip = 0
+        self._epoch_rng = np.random.get_state()
+        self._batches_served = skip
+        batches = self._sampler_batches(skip)
         batchify = self._batchify_fn or default_batchify_fn
         if self._num_workers == 0:
-            for batch in self._batch_sampler:
-                yield batchify([self._dataset[i] for i in batch])
+            for batch in batches:
+                out = batchify([self._dataset[i] for i in batch])
+                self._batches_served += 1
+                yield out
             return
         if self._thread_pool:
             with _futures.ThreadPoolExecutor(self._num_workers) as pool:
@@ -245,13 +310,14 @@ class DataLoader:
                         lambda: batchify(
                             [self._dataset[i] for i in idxs]))
                 for fut in _bounded_window(
-                        self._batch_sampler, submit,
-                        2 * self._num_workers):
-                    yield fut.result()
+                        batches, submit, 2 * self._num_workers):
+                    out = fut.result()
+                    self._batches_served += 1
+                    yield out
             return
-        yield from self._iter_multiprocess()
+        yield from self._iter_multiprocess(batches)
 
-    def _iter_multiprocess(self):
+    def _iter_multiprocess(self, batches):
         # fork: the dataset is inherited copy-on-write (no pickling);
         # workers are numpy-only so re-entering an already-initialized
         # accelerator runtime in the child never happens.
@@ -286,8 +352,12 @@ class DataLoader:
                 initargs=(self._dataset, worker_batchify, prefix,
                           accel))
         try:
+            import itertools as _it
             import time as _time
-            grace = float(os.environ.get("MXTPU_DL_DEAD_GRACE", "60"))
+            grace = get_env("MXTPU_DL_DEAD_GRACE")
+            max_restarts = get_env("MXTPU_DATA_WORKER_RESTARTS")
+            restarts_used = 0
+            tokens = _it.count()
             # respawn-generation bookkeeping: a task is only suspect
             # if the worker set changed AFTER it was submitted.  A
             # global "pids look healthy now" snapshot cannot express
@@ -304,14 +374,16 @@ class DataLoader:
                     known_pids = pids
                 return respawn_gen
 
-            for res, submit_gen in _bounded_window(
-                    self._batch_sampler,
-                    # observe at submission: a respawn that happened
-                    # while no result was being polled must not count
-                    # against tasks submitted after it
-                    lambda idxs: (pool.apply_async(_worker_fn, (idxs,)),
-                                  _observe_pids()),
-                    2 * self._num_workers):
+            def _submit(idxs):
+                # observe at submission: a respawn that happened
+                # while no result was being polled must not count
+                # against tasks submitted after it
+                token = "%x" % next(tokens)
+                return (pool.apply_async(_worker_fn, (idxs, token)),
+                        _observe_pids(), idxs, token)
+
+            for res, submit_gen, idxs, token in _bounded_window(
+                    batches, _submit, 2 * self._num_workers):
                 # poll with a timeout: if a worker dies hard (native
                 # segfault, OOM-kill), Pool respawns it but the lost
                 # task's result never arrives — a bare get() would
@@ -319,35 +391,87 @@ class DataLoader:
                 # not proof THIS result is lost (the died worker may
                 # have held a different task), so a result submitted
                 # before the respawn gets a grace window to arrive.
+                # A task declared lost has its half-built segments
+                # swept and its index batch re-dispatched to the
+                # (Pool-respawned) workers, up to the
+                # MXTPU_DATA_WORKER_RESTARTS budget.
                 deadline = None
+                data_timeout = get_env("MXTPU_DATA_TIMEOUT")
+                hard_deadline = _time.monotonic() + data_timeout \
+                    if data_timeout > 0 else None
                 while True:
                     try:
-                        desc = res.get(5.0)
+                        desc = res.get(1.0)
                         break
                     except _mp.TimeoutError:
                         if _observe_pids() == submit_gen:
-                            continue    # no respawn since submission
+                            # no respawn since (re)submission.  Only
+                            # here does the absolute backstop apply —
+                            # a pool wedged with no death evidence
+                            # (e.g. a worker killed at the worst
+                            # moment) must still bound the wait.  A
+                            # respawn hands over to the grace +
+                            # re-dispatch path below instead, so a
+                            # short MXTPU_DATA_TIMEOUT can never
+                            # preempt the recovery budget
+                            if hard_deadline is not None and \
+                                    _time.monotonic() > hard_deadline:
+                                raise DataPipelineError(
+                                    "DataLoader: no batch arrived "
+                                    f"within {data_timeout:g}s "
+                                    "(MXTPU_DATA_TIMEOUT); the "
+                                    "worker pool is stalled — check "
+                                    "dataset __getitem__ for hangs "
+                                    "or raise the timeout for slow "
+                                    "sources") from None
+                            continue
                         if deadline is None:
                             deadline = _time.monotonic() + grace
-                        elif _time.monotonic() > deadline:
-                            raise RuntimeError(
+                            continue
+                        if _time.monotonic() <= deadline:
+                            continue
+                        _sweep_segments(prefix + token + "_")
+                        if restarts_used >= max_restarts:
+                            raise DataPipelineError(
                                 "a DataLoader worker died and its "
-                                "batch never arrived (waited "
-                                f"{grace:.0f}s); check dataset "
+                                f"batch never arrived (waited "
+                                f"{grace:.0f}s after the respawn, "
+                                f"re-dispatched {restarts_used} "
+                                "time(s), MXTPU_DATA_WORKER_RESTARTS"
+                                f"={max_restarts}); check dataset "
                                 "__getitem__/batchify_fn for crashes "
                                 "in native code or OOM "
                                 "(MXTPU_DL_DEAD_GRACE overrides the "
                                 "wait)")
-                yield promote(_from_shm(desc))
+                        restarts_used += 1
+                        warnings.warn(
+                            "a DataLoader worker died holding batch "
+                            f"{idxs[:4]}{'...' if len(idxs) > 4 else ''}; "
+                            "re-dispatching it (restart "
+                            f"{restarts_used}/{max_restarts})",
+                            RuntimeWarning)
+                        token = "%x" % next(tokens)
+                        res = pool.apply_async(_worker_fn,
+                                               (idxs, token))
+                        submit_gen = _observe_pids()
+                        deadline = None
+                        if hard_deadline is not None:
+                            # fresh dispatch, fresh backstop window
+                            hard_deadline = _time.monotonic() \
+                                + data_timeout
+                    except Exception as exc:
+                        # a worker that *raised* (vs died): surface
+                        # as a typed pipeline failure with the cause
+                        raise DataPipelineError(
+                            "DataLoader worker raised "
+                            f"{type(exc).__name__}: {exc}") from exc
+                out = promote(_from_shm(desc))
+                self._batches_served += 1
+                yield out
         finally:
             pool.terminate()
             pool.join()
-            import glob as _glob
-            for path in _glob.glob("/dev/shm/" + prefix + "*"):
-                try:
-                    os.unlink(path)
-                except OSError:
-                    pass
+            _sweep_segments(prefix)
 
     def __len__(self):
         return len(self._batch_sampler)

@@ -18,8 +18,11 @@ import numpy as np
 import warnings
 
 from .. import recordio as rio
-from ..io.io import DataBatch, DataDesc, DataIter
+from ..io.io import (DataBatch, DataDesc, DataIter, _bounded_get,
+                     _stop_aware_put)
 from ..ndarray.ndarray import array as nd_array
+from ..resilience import DataPipelineError, inject
+from ..utils.env import get_env
 from .image import CreateAugmenter, augment_to_chw, imdecode
 
 __all__ = ["ImageRecordIter"]
@@ -103,20 +106,111 @@ class ImageRecordIter(DataIter):
         self._prefetch_q = queue.Queue(maxsize=prefetch_buffer)
         self._producer = None
         self._stop = threading.Event()
+        self._path = path_imgrec
+        self._bad_records = 0       # cumulative corrupt-record count
+        self._nbatch = 0            # batches delivered this epoch
+        self._records_consumed = 0  # stream events through last
+                                    # delivered batch (quarantines
+                                    # consume extra records, so this
+                                    # is NOT nbatch * batch_size)
+        self._skip_batches = 0      # replay-discard count (skip())
+        self._resume_pending = False
+        self._resume_nbatch = 0
+        self._resume_consumed = 0
+        self._resume_skip = 0
         self.reset()
 
     # ------------------------------------------------------------ epoch
     def reset(self):
         self._drain()
-        if self._keys is not None and self.shuffle:
-            np.random.shuffle(self._keys)
+        if self._resume_pending:
+            # a just-restored position survives the train loop's
+            # epoch-start reset (one-shot): keys order came from the
+            # state_dict, so no reshuffle, and the producer restarts
+            # the stream at the recorded consumption point
+            self._resume_pending = False
+            self._nbatch = self._resume_nbatch
+            self._records_consumed = self._resume_consumed
+            self._skip_batches = self._resume_skip
+            self._resume_skip = 0
+        else:
+            if self._keys is not None and self.shuffle:
+                np.random.shuffle(self._keys)
+            self._nbatch = 0
+            self._records_consumed = 0
+            self._skip_batches = 0
         if self._keys is None:
             self._rec.reset()
-        self._cursor = 0
         self._stop.clear()
         self._producer = threading.Thread(target=self._produce,
                                           daemon=True)
         self._producer.start()
+
+    def state_dict(self):
+        """Position snapshot: delivered-batch count + the exact
+        stream-consumption count through the last delivered batch
+        (quarantined records consume extra stream events, so this is
+        not derivable from nbatch) + epoch key order + cumulative
+        bad-record count + numpy RNG state (shuffle source).  The
+        producer thread reads ahead of next(), so delivered-batch
+        accounting — not the reader cursor — is the resume point."""
+        if self._resume_pending:
+            nbatch, consumed, skip = (self._resume_nbatch,
+                                      self._resume_consumed,
+                                      self._resume_skip)
+        else:
+            nbatch, consumed, skip = (self._nbatch,
+                                      self._records_consumed,
+                                      self._skip_batches)
+        return {"type": "ImageRecordIter",
+                "nbatch": nbatch,
+                "consumed": consumed,
+                "skip": skip,
+                "keys": list(self._keys)
+                if self._keys is not None else None,
+                "bad_records": self._bad_records,
+                "np_rng": np.random.get_state()}
+
+    def load_state_dict(self, state):
+        if state.get("type") != "ImageRecordIter":
+            raise ValueError(
+                f"state_dict type {state.get('type')!r} does not "
+                "match ImageRecordIter")
+        keys = state.get("keys")
+        if (keys is None) != (self._keys is None):
+            raise ValueError(
+                "iterator state and this ImageRecordIter disagree "
+                "about having an .idx file — state from a different "
+                "dataset?")
+        self._drain()
+        if keys is not None:
+            self._keys = list(keys)
+        self._bad_records = int(state.get("bad_records", 0))
+        if state.get("np_rng") is not None:
+            np.random.set_state(state["np_rng"])
+        self._resume_nbatch = int(state["nbatch"])
+        self._resume_consumed = int(state["consumed"])
+        self._resume_skip = int(state.get("skip", 0))
+        self._resume_pending = True
+
+    def skip(self, num_batches):
+        """Fast-forward ``num_batches``: the producer replays them as
+        discards from the recorded consumption point — assembling
+        (and decoding) but not delivering — which stays exact even
+        when quarantined records shifted per-batch consumption."""
+        if self._resume_pending:
+            base, consumed, skip = (self._resume_nbatch,
+                                    self._resume_consumed,
+                                    self._resume_skip)
+        else:
+            base, consumed, skip = (self._nbatch,
+                                    self._records_consumed,
+                                    self._skip_batches)
+        self._resume_nbatch = base + num_batches
+        self._resume_consumed = consumed
+        self._resume_skip = skip + num_batches
+        self._resume_pending = True
+        self.reset()
 
     def _drain(self):
         """Stop the producer and empty the queue race-free: the
@@ -145,96 +239,203 @@ class ImageRecordIter(DataIter):
                 return self._rec.read_idx(self._keys[i])
             return self._rec.read()
 
-    def _decode_one(self, raw):
-        return self._decode_unpacked(rio.unpack(raw))
-
     def _decode_unpacked(self, pair):
         header, img_bytes = pair
         arr = augment_to_chw(imdecode(img_bytes), self.auglist)
         label = np.atleast_1d(np.asarray(header.label, np.float32))
         return arr, label
 
+    def _safe_decode(self, pair):
+        """(arr, label) on success, (None, exc) on a decode failure —
+        run in the pool, where a raise would be per-future noise; the
+        producer turns failures into quarantine decisions."""
+        try:
+            return self._decode_unpacked(pair)
+        except Exception as exc:
+            return None, exc
+
+    def _quarantine(self, exc, where):
+        """Count one corrupt record against MXTPU_MAX_BAD_RECORDS:
+        skip-and-log within the budget, raise past it."""
+        self._bad_records += 1
+        budget = get_env("MXTPU_MAX_BAD_RECORDS")
+        if self._bad_records > budget:
+            raise DataPipelineError(
+                f"ImageRecordIter: {self._bad_records} corrupt "
+                f"record(s) in {self._path} exceed "
+                f"MXTPU_MAX_BAD_RECORDS={budget} (last failure at "
+                f"{where}: {exc}); raise the budget to tolerate "
+                "more, or repair the dataset") from exc
+        warnings.warn(
+            f"ImageRecordIter: skipping corrupt record in "
+            f"{self._path} ({where}: {exc}); bad-record budget "
+            f"{self._bad_records}/{budget}", RuntimeWarning)
+
     def _put(self, item):
         """Stop-aware put so a blocked producer can exit on reset."""
-        while not self._stop.is_set():
+        return _stop_aware_put(self._prefetch_q, self._stop, item)
+
+    def _records(self, consumed):
+        """Generator of unpacked (header, img_bytes) pairs starting
+        at stream event ``consumed["n"]``, quarantining corrupt
+        reads/unpacks: the sequential backend resyncs the stream to
+        the next magic, the keyed backend skips the bad key.
+
+        ``consumed["n"]`` counts *stream events* — yielded records,
+        unpack failures, and bad reads (one event per skipped key /
+        resynced region) — so it is the exact resume coordinate even
+        when quarantine consumed extra records per batch (keyed path:
+        it equals the key index)."""
+        n = len(self._keys) if self._keys is not None else None
+        while True:
+            i = consumed["n"]
+            if n is not None and i >= n:
+                return
             try:
-                self._prefetch_q.put(item, timeout=0.05)
-                return True
-            except queue.Full:
+                raw = self._read_raw(i)
+            except IOError as exc:
+                consumed["n"] += 1
+                self._quarantine(exc, "read")
+                if n is None:
+                    with self._lock:
+                        if self._rec.resync() is None:
+                            return      # no further record magic
                 continue
-        return False
+            if raw is None:
+                return
+            consumed["n"] += 1
+            try:
+                pair = rio.unpack(raw)
+            except Exception as exc:
+                self._quarantine(exc, "unpack")
+                continue
+            yield pair
+
+    def _spool_sequential(self, num_events):
+        """Sequential (no-.idx) resume: spool past ``num_events``
+        already-consumed stream events without decoding, using the
+        same event accounting as :meth:`_records` (a bad read +
+        resync is one event) and without re-counting quarantines the
+        pre-checkpoint run already charged to the budget."""
+        left = num_events
+        while left > 0 and not self._stop.is_set():
+            try:
+                if self._rec.read() is None:
+                    return
+            except IOError:
+                with self._lock:
+                    if self._rec.resync() is None:
+                        return
+            left -= 1
 
     def _produce(self):
         try:
             n = len(self._keys) if self._keys is not None else None
-            i = 0
+            consumed = {"n": self._records_consumed}
+            skip = self._skip_batches
+            if n is None and consumed["n"]:
+                self._spool_sequential(consumed["n"])
+            rec_gen = self._records(consumed)
             while not self._stop.is_set():
-                raws = []
-                while len(raws) < self.batch_size:
-                    if n is not None and i >= n:
+                inject("data", "record_batch")
+                pairs = []
+                while len(pairs) < self.batch_size:
+                    pair = next(rec_gen, None)
+                    if pair is None:
                         break
-                    raw = self._read_raw(i)
-                    if raw is None:
-                        break
-                    raws.append(raw)
-                    i += 1
-                if not raws:
+                    pairs.append(pair)
+                if not pairs:
                     break
-                pad = self.batch_size - len(raws)
-                if pad > 0 and self.round_batch and n is not None:
-                    # wrap the tail with epoch-start samples (ref:
-                    # round_batch semantics of the C++ iterator)
-                    for j in range(pad):
-                        raws.append(self._read_raw(j % n))
+                pad = self.batch_size - len(pairs)
                 c, h, w = self.data_shape
                 data = np.zeros((self.batch_size, c, h, w),
                                 np.float32)
                 label = np.zeros((self.batch_size, self.label_width),
                                  np.float32)
+                filled = 0
                 done = False
-                if self._native is not None:
-                    unpacked = [rio.unpack(raw) for raw in raws]
-                    # libjpeg-only: non-JPEG batches (PNG/BMP) or
-                    # jpegs libjpeg rejects but PIL handles (CMYK)
-                    # fall back to the PIL path on the SAME unpacked
-                    # records — never abort what PIL could decode
-                    if all(ib[:2] == b"\xff\xd8"
-                           for _, ib in unpacked):
-                        from . import native_dec
-                        cfg = self._native
-                        imgs = [ib for _, ib in unpacked]
-                        mirror = None
-                        if cfg["mirror_p"] > 0:
-                            mirror = (np.random.rand(len(imgs))
-                                      < cfg["mirror_p"])
-                        try:
-                            native_dec.decode_batch(
-                                imgs, (h, w), mirror=mirror,
-                                mean=cfg["mean"], std=cfg["std"],
-                                nthreads=cfg["nthreads"],
-                                out=data[:len(imgs)])
-                            done = True
-                        except ValueError:
-                            pass    # PIL fallback below decides
-                    if done:
-                        for j, (header, _) in enumerate(unpacked):
-                            lab = np.atleast_1d(np.asarray(
-                                header.label, np.float32))
-                            label[j] = lab[:self.label_width]
-                    else:
-                        decoded = list(self._pool.map(
-                            self._decode_unpacked, unpacked))
-                        for j, (arr, lab) in enumerate(decoded):
-                            data[j] = arr
-                            label[j] = lab[:self.label_width]
+                # libjpeg-only: non-JPEG batches (PNG/BMP) or jpegs
+                # libjpeg rejects but PIL handles (CMYK) fall back to
+                # the PIL path on the SAME unpacked records — never
+                # abort what PIL could decode
+                if self._native is not None and \
+                        all(ib[:2] == b"\xff\xd8" for _, ib in pairs):
+                    from . import native_dec
+                    cfg = self._native
+                    imgs = [ib for _, ib in pairs]
+                    mirror = None
+                    if cfg["mirror_p"] > 0:
+                        mirror = (np.random.rand(len(imgs))
+                                  < cfg["mirror_p"])
+                    try:
+                        native_dec.decode_batch(
+                            imgs, (h, w), mirror=mirror,
+                            mean=cfg["mean"], std=cfg["std"],
+                            nthreads=cfg["nthreads"],
+                            out=data[:len(imgs)])
                         done = True
-                if not done:
-                    decoded = list(self._pool.map(self._decode_one,
-                                                  raws))
-                    for j, (arr, lab) in enumerate(decoded):
-                        data[j] = arr
+                    except ValueError:
+                        pass    # PIL fallback below decides
+                if done:
+                    for j, (header, _) in enumerate(pairs):
+                        lab = np.atleast_1d(np.asarray(
+                            header.label, np.float32))
                         label[j] = lab[:self.label_width]
-                if not self._put((data, label, pad)):
+                    filled = len(pairs)
+                else:
+                    # PIL path with per-record quarantine: decode
+                    # failures are skipped and replaced from the
+                    # stream so mid-epoch batches stay full
+                    pending = pairs
+                    while pending:
+                        decoded = list(self._pool.map(
+                            self._safe_decode, pending))
+                        lost = 0
+                        for arr, payload in decoded:
+                            if arr is None:
+                                self._quarantine(payload, "decode")
+                                lost += 1
+                            elif filled < self.batch_size:
+                                data[filled] = arr
+                                label[filled] = \
+                                    payload[:self.label_width]
+                                filled += 1
+                        if not lost:
+                            break
+                        pending = []
+                        while len(pending) < lost:
+                            pair = next(rec_gen, None)
+                            if pair is None:
+                                break
+                            pending.append(pair)
+                    pad = self.batch_size - filled
+                if pad > 0 and self.round_batch and n is not None:
+                    # wrap the tail with epoch-start samples (ref:
+                    # round_batch semantics of the C++ iterator);
+                    # wrap filler is stripped by pad-aware consumers,
+                    # so a corrupt wrap record is simply skipped
+                    j = 0
+                    while filled < self.batch_size and j < 2 * n:
+                        try:
+                            arr, lab = self._decode_unpacked(
+                                rio.unpack(self._read_raw(j % n)))
+                        except Exception:
+                            j += 1
+                            continue
+                        data[filled] = arr
+                        label[filled] = lab[:self.label_width]
+                        filled += 1
+                        j += 1
+                if skip > 0:
+                    # replay-discard (skip()): the batch was
+                    # assembled so consumption advanced exactly as in
+                    # the original run, but it was already delivered
+                    # pre-checkpoint — drop it
+                    skip -= 1
+                    if pad > 0:
+                        break
+                    continue
+                if not self._put((data, label, pad, consumed["n"])):
                     return  # reset() interrupted us; no sentinel
                 if pad > 0:
                     break
@@ -244,9 +445,13 @@ class ImageRecordIter(DataIter):
 
     # ------------------------------------------------------------ iter
     def next(self):
+        if self._resume_pending:
+            self.reset()    # applies the restored position
         if self._producer is None:
             raise StopIteration  # epoch ended; call reset()
-        item = self._prefetch_q.get()
+        item = _bounded_get(self._prefetch_q,
+                            f"ImageRecordIter({self._path})",
+                            thread=self._producer)
         if item is None:
             self._producer.join(timeout=5)
             self._producer = None
@@ -254,8 +459,18 @@ class ImageRecordIter(DataIter):
         if isinstance(item, tuple) and len(item) == 2 and \
                 item[0] == "error":
             self._producer = None
-            raise item[1]
-        data, label, pad = item
+            exc = item[1]
+            if isinstance(exc, DataPipelineError):
+                raise exc
+            err = DataPipelineError(
+                f"ImageRecordIter({self._path}) producer raised "
+                f"{type(exc).__name__}: {exc}")
+            err.__cause__ = exc
+            raise err
+        data, label, pad, consumed = item
+        self._nbatch += 1
+        self._records_consumed = consumed
+        self._skip_batches = 0   # any replay-discard phase is over
         label_out = label[:, 0] if self.label_width == 1 else label
         return DataBatch([nd_array(data)], [nd_array(label_out)],
                          pad=pad, provide_data=self.provide_data,

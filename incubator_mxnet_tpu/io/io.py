@@ -16,16 +16,102 @@ import gzip
 import queue
 import struct
 import threading
+import time
 
 import numpy as np
 
 from ..ndarray import array as nd_array
 from ..ndarray.ndarray import NDArray
+from ..resilience import DataPipelineError, data_timeout, inject
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter",
            "ResizeIter", "PrefetchingIter", "DevicePrefetchIter",
            "CSVIter", "MNISTIter",
            "LibSVMIter", "ImageRecordIter"]
+
+# prefetch consumers poll in short slices so a dead producer thread
+# is noticed within one slice, not only at the full data timeout
+_GET_POLL_S = 0.2
+
+
+def _bounded_get(q, source, thread=None, timeout=None):
+    """``q.get()`` bounded by ``MXTPU_DATA_TIMEOUT``.
+
+    Raises :class:`DataPipelineError` naming ``source`` when the
+    producer thread has died without delivering, or when nothing
+    arrives within the deadline — the two ways a background producer
+    can otherwise hang its consumer forever.  ``timeout=None`` reads
+    the env flag; a value <= 0 disables the deadline (the dead-thread
+    check still applies)."""
+    if timeout is None:
+        timeout = data_timeout()
+    deadline = time.monotonic() + timeout \
+        if timeout and timeout > 0 else None
+    while True:
+        try:
+            return q.get(timeout=_GET_POLL_S)
+        except queue.Empty:
+            pass
+        if thread is not None and not thread.is_alive():
+            try:  # the final put may have landed after our get timed out
+                return q.get_nowait()
+            except queue.Empty:
+                raise DataPipelineError(
+                    f"{source}: prefetch worker thread died without "
+                    "delivering a batch, end-of-epoch, or error "
+                    "(killed mid-put?); the stream cannot continue"
+                ) from None
+        if deadline is not None and time.monotonic() >= deadline:
+            raise DataPipelineError(
+                f"{source} stalled: no batch arrived within "
+                f"{timeout:g}s (MXTPU_DATA_TIMEOUT); the upstream "
+                "iterator or its storage is wedged — raise the "
+                "timeout for slow sources, or inspect the source "
+                "named above") from None
+
+
+def _stop_aware_put(q, stop, item):
+    """Bounded put that re-checks ``stop`` so a producer blocked on a
+    full queue can always observe reset/teardown (the reset-deadlock
+    window of a bare ``q.put()``).  True when the item landed."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=0.05)
+            return True
+        except queue.Full:
+            continue
+    return False
+
+
+def _halt_worker_thread(thread, q, stop, source, timeout=30):
+    """Stop + join a prefetch producer and drain its queue race-free:
+    set ``stop`` (the producer's stop-aware put exits on it), drain
+    *while* joining (a producer mid-``put`` needs a slot freed to
+    notice the event), then re-drain after the join so no item from a
+    final put survives — the deadlock/stale-item window of a naive
+    drain-then-join order.  A worker still alive past ``timeout`` is
+    wedged inside the inner iterator; resetting the shared inner
+    under a live consumer would interleave two readers, so fail
+    loudly instead."""
+    stop.set()
+    if thread is not None:
+        deadline = time.monotonic() + timeout
+        while thread.is_alive():
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                pass
+            thread.join(timeout=0.05)
+            if time.monotonic() > deadline:
+                raise DataPipelineError(
+                    f"{source}: worker still blocked in the inner "
+                    f"iterator after {timeout}s; it cannot be reset "
+                    "safely (check the inner iterator for hangs)")
+    try:
+        while True:
+            q.get_nowait()
+    except queue.Empty:
+        pass
 
 
 class DataDesc:
@@ -102,6 +188,34 @@ class DataIter:
     def getpad(self):
         return 0
 
+    # ------------------------------------------------- resumable state
+    def state_dict(self):
+        """Checkpointable position: enough to resume the stream at
+        the exact batch after a restart (epoch order, cursor, RNG,
+        bad-record count — see docs/data_pipeline.md).  Saved
+        alongside model checkpoints by ``model.save_data_state``."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support checkpointable "
+            "iterator state (state_dict)")
+
+    def load_state_dict(self, state):
+        """Restore a :meth:`state_dict` snapshot.  The restored
+        position survives exactly one subsequent ``reset()`` (train
+        loops reset at epoch start before iterating, and a resumed
+        run must not rewind to batch 0); iterating disarms that
+        shield, so the next epoch boundary reshuffles normally."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support checkpointable "
+            "iterator state (load_state_dict)")
+
+    def skip(self, num_batches):
+        """Advance past ``num_batches`` without delivering them (used
+        by prefetch wrappers to fast-forward an inner iterator to a
+        resume point).  Subclasses with a cursor override this to
+        skip without materializing data."""
+        for _ in range(num_batches):
+            self.next()
+
 
 def _init_data(data, allow_empty, default_name):
     """Normalize data into list of (name, numpy array)."""
@@ -141,6 +255,7 @@ class NDArrayIter(DataIter):
         self.last_batch_handle = last_batch_handle
         self.cursor = -batch_size
         self._carry = np.array([], dtype=np.int64)  # roll_over leftovers
+        self._resume_pending = False
         self._order = np.arange(self.num_data)
         if shuffle:
             np.random.shuffle(self._order)
@@ -161,6 +276,12 @@ class NDArrayIter(DataIter):
                          v.dtype) for k, v in self.label]
 
     def reset(self):
+        if getattr(self, "_resume_pending", False):
+            # a just-restored position must survive the epoch-start
+            # reset of the training loop (fit() resets before
+            # iterating) — one-shot, disarmed here or by iterating
+            self._resume_pending = False
+            return
         base = np.arange(self.num_data)
         if self.shuffle:
             np.random.shuffle(base)
@@ -173,7 +294,42 @@ class NDArrayIter(DataIter):
             self._order = base
         self.cursor = -self.batch_size
 
+    def state_dict(self):
+        """Position snapshot: cursor + epoch order + roll-over carry
+        + global numpy RNG state (the shuffle source), so a restore
+        replays both the remaining batches of this epoch and every
+        later epoch's shuffle."""
+        return {"type": "NDArrayIter",
+                "cursor": int(self.cursor),
+                "order": self._order.copy(),
+                "carry": self._carry.copy(),
+                "np_rng": np.random.get_state()}
+
+    def load_state_dict(self, state):
+        if state.get("type") != "NDArrayIter":
+            raise ValueError(
+                f"state_dict type {state.get('type')!r} does not "
+                "match NDArrayIter")
+        order = np.asarray(state["order"], dtype=np.int64)
+        if len(order) and order.max() >= self.num_data:
+            raise ValueError(
+                "iterator state references sample "
+                f"{int(order.max())} but the dataset has only "
+                f"{self.num_data} samples — state from a different "
+                "dataset?")
+        self._order = order
+        self._carry = np.asarray(state["carry"], dtype=np.int64)
+        self.cursor = int(state["cursor"])
+        if state.get("np_rng") is not None:
+            np.random.set_state(state["np_rng"])
+        self._resume_pending = True
+
+    def skip(self, num_batches):
+        self._resume_pending = False
+        self.cursor += num_batches * self.batch_size
+
     def iter_next(self):
+        self._resume_pending = False
         self.cursor += self.batch_size
         if self.last_batch_handle == "discard":
             return self.cursor + self.batch_size <= self.num_data
@@ -273,16 +429,31 @@ class PrefetchingIter(DataIter):
         self._queue = queue.Queue(maxsize=prefetch_depth)
         self._stop = threading.Event()
         self._thread = None
+        self._exhausted = False
+        self._error = None
+        self._delivered = 0
+        self._pending_resume = None
+        self._capture_epoch_state()
         self._start()
 
     def _start(self):
+        # the worker must capture ITS queue/stop: reading them off
+        # self would let a worker that outlives a reset() resurrect
+        # into the replacement queue
+        q, stop = self._queue, self._stop
+
         def worker():
-            while not self._stop.is_set():
+            while not stop.is_set():
                 try:
+                    inject("data", "prefetch")
                     batches = [it.next() for it in self.iters]
-                    self._queue.put(batches)
                 except StopIteration:
-                    self._queue.put(None)
+                    _stop_aware_put(q, stop, None)
+                    return
+                except Exception as exc:   # surface in the consumer
+                    _stop_aware_put(q, stop, ("err", exc))
+                    return
+                if not _stop_aware_put(q, stop, batches):
                     return
         self._thread = threading.Thread(target=worker, daemon=True)
         self._thread.start()
@@ -310,29 +481,90 @@ class PrefetchingIter(DataIter):
             out.extend(descs)
         return out
 
-    def reset(self):
-        self._stop.set()
+    def _halt_worker(self):
+        _halt_worker_thread(self._thread, self._queue, self._stop,
+                            "PrefetchingIter")
+        self._thread = None
+
+    def _capture_epoch_state(self):
+        """Snapshot the inner iterators' epoch-start state; the
+        worker read-ahead makes their *live* state run ahead of what
+        the consumer has seen, so state_dict pairs this snapshot with
+        the delivered-batch count instead."""
         try:
-            while True:
-                self._queue.get_nowait()
-        except queue.Empty:
-            pass
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-        for it in self.iters:
-            it.reset()
+            self._epoch_state = [it.state_dict() for it in self.iters]
+        except NotImplementedError:
+            self._epoch_state = None
+
+    def reset(self):
+        self._halt_worker()
+        if self._pending_resume is not None:
+            self._apply_resume()
+        else:
+            for it in self.iters:
+                it.reset()
         self._stop = threading.Event()
         self._queue = queue.Queue(maxsize=self._depth)
         self._exhausted = False
+        self._error = None
+        self._delivered = 0
+        self._capture_epoch_state()
         self._start()
 
+    def _apply_resume(self):
+        state = self._pending_resume
+        self._pending_resume = None
+        for it, s in zip(self.iters, state["inner"]):
+            it.load_state_dict(s)
+            # skip() fast-forwards to the resume batch and disarms
+            # the inner one-shot reset shield — the prefetcher owns
+            # resume here, so the next inner reset must be a real one
+            it.skip(state["delivered"])
+
+    def state_dict(self):
+        if self._pending_resume is not None:
+            return dict(self._pending_resume)    # un-applied restore
+        if self._epoch_state is None:
+            raise NotImplementedError(
+                "PrefetchingIter state needs every inner iterator to "
+                "implement state_dict")
+        return {"type": "PrefetchingIter",
+                "inner": self._epoch_state,
+                "delivered": self._delivered}
+
+    def load_state_dict(self, state):
+        if len(state.get("inner") or []) != len(self.iters):
+            raise ValueError(
+                "state_dict holds state for "
+                f"{len(state.get('inner') or [])} inner iterators; "
+                f"this PrefetchingIter wraps {len(self.iters)}")
+        self._halt_worker()
+        self._pending_resume = dict(state)
+
     def next(self):
-        if getattr(self, "_exhausted", False):
+        if self._pending_resume is not None:
+            self.reset()    # applies the restored position
+        if self._exhausted:
             raise StopIteration
-        batches = self._queue.get()
-        if batches is None:
+        if self._error is not None:
+            raise self._error
+        item = _bounded_get(self._queue, "PrefetchingIter",
+                            thread=self._thread)
+        if item is None:
             self._exhausted = True  # worker exited; don't block again
             raise StopIteration
+        if isinstance(item, tuple) and len(item) == 2 \
+                and item[0] == "err":
+            if isinstance(item[1], DataPipelineError):
+                self._error = item[1]   # already typed: keep the
+            else:                       # actionable message on top
+                self._error = DataPipelineError(
+                    f"PrefetchingIter worker raised "
+                    f"{type(item[1]).__name__}: {item[1]}")
+                self._error.__cause__ = item[1]
+            raise self._error
+        batches = item
+        self._delivered += 1
         data = [d for b in batches for d in b.data]
         label = [l for b in batches for l in b.label]
         return DataBatch(data, label, pad=batches[0].pad)
@@ -367,7 +599,16 @@ class DevicePrefetchIter(DataIter):
         self._iter = data_iter
         self._ctx = ctx or default_context()
         self._depth = depth
+        self._delivered = 0
+        self._pending_resume = None
+        self._capture_epoch_state()
         self._spawn()
+
+    def _capture_epoch_state(self):
+        try:
+            self._epoch_state = self._iter.state_dict()
+        except NotImplementedError:
+            self._epoch_state = None
 
     def _spawn(self):
         self._queue = queue.Queue(maxsize=self._depth)
@@ -383,12 +624,13 @@ class DevicePrefetchIter(DataIter):
             dev = self._ctx.jax_device
             while not stop.is_set():
                 try:
+                    inject("data", "device_prefetch")
                     batch = self._iter.next()
                 except StopIteration:
-                    q.put(("end", None))
+                    _stop_aware_put(q, stop, ("end", None))
                     return
                 except Exception as exc:     # surface in consumer
-                    q.put(("err", exc))
+                    _stop_aware_put(q, stop, ("err", exc))
                     return
                 try:
                     stage = [NDArray(jax.device_put(a._data, dev),
@@ -398,13 +640,15 @@ class DevicePrefetchIter(DataIter):
                                      self._ctx)
                              for a in (batch.label or [])]
                 except Exception as exc:
-                    q.put(("err", exc))
+                    _stop_aware_put(q, stop, ("err", exc))
                     return
-                q.put(("ok", DataBatch(
-                    stage, label, pad=batch.pad,
-                    provide_data=getattr(batch, "provide_data", None),
-                    provide_label=getattr(batch, "provide_label",
-                                          None))))
+                if not _stop_aware_put(q, stop, ("ok", DataBatch(
+                        stage, label, pad=batch.pad,
+                        provide_data=getattr(batch, "provide_data",
+                                             None),
+                        provide_label=getattr(batch, "provide_label",
+                                              None)))):
+                    return
 
         self._thread = threading.Thread(target=worker, daemon=True)
         self._thread.start()
@@ -417,37 +661,66 @@ class DevicePrefetchIter(DataIter):
     def provide_label(self):
         return self._iter.provide_label
 
+    def _halt_worker(self):
+        _halt_worker_thread(self._thread, self._queue, self._stop,
+                            "DevicePrefetchIter")
+
     def reset(self):
-        self._stop.set()
-        try:
-            while True:
-                self._queue.get_nowait()
-        except queue.Empty:
-            pass
-        self._thread.join(timeout=30)
-        if self._thread.is_alive():
-            # resetting the shared inner iterator under a live worker
-            # would interleave two consumers — fail loudly instead
-            raise RuntimeError(
-                "DevicePrefetchIter.reset: worker still blocked in "
-                "the inner iterator after 30s; it cannot be reset "
-                "safely (check the inner iterator for hangs)")
-        self._iter.reset()
+        self._halt_worker()
+        if self._pending_resume is not None:
+            state = self._pending_resume
+            self._pending_resume = None
+            self._iter.load_state_dict(state["inner"])
+            self._iter.skip(state["delivered"])
+        else:
+            self._iter.reset()
+        self._delivered = 0
+        self._capture_epoch_state()
         self._spawn()
 
+    def state_dict(self):
+        if self._pending_resume is not None:
+            return dict(self._pending_resume)
+        if self._epoch_state is None:
+            raise NotImplementedError(
+                "DevicePrefetchIter state needs the inner iterator "
+                "to implement state_dict")
+        return {"type": "DevicePrefetchIter",
+                "inner": self._epoch_state,
+                "delivered": self._delivered}
+
+    def load_state_dict(self, state):
+        if state.get("type") != "DevicePrefetchIter":
+            raise ValueError(
+                f"state_dict type {state.get('type')!r} does not "
+                "match DevicePrefetchIter")
+        self._halt_worker()
+        self._pending_resume = dict(state)
+
     def next(self):
+        if self._pending_resume is not None:
+            self.reset()    # applies the restored position
         if self._terminal is not None:     # worker is gone: re-raise
             kind, payload = self._terminal  # instead of blocking on a
             if kind == "end":               # producerless queue
                 raise StopIteration
             raise payload
-        kind, payload = self._queue.get()
+        kind, payload = _bounded_get(self._queue, "DevicePrefetchIter",
+                                     thread=self._thread)
         if kind == "end":
             self._terminal = (kind, payload)
             raise StopIteration
         if kind == "err":
-            self._terminal = (kind, payload)
-            raise payload
+            if isinstance(payload, DataPipelineError):
+                err = payload           # already typed: keep the
+            else:                       # actionable message on top
+                err = DataPipelineError(
+                    f"DevicePrefetchIter worker raised "
+                    f"{type(payload).__name__}: {payload}")
+                err.__cause__ = payload
+            self._terminal = (kind, err)
+            raise err
+        self._delivered += 1
         return payload
 
     def iter_next(self):

@@ -8,6 +8,7 @@ from . import ndarray as nd
 from . import symbol as sym
 
 __all__ = ["BatchEndParam", "save_checkpoint", "load_checkpoint",
+           "save_data_state", "load_data_state",
            "_create_kvstore", "FeedForward"]
 
 BatchEndParam = collections.namedtuple(
@@ -98,6 +99,58 @@ def checkpoint_companion_path(prefix, epoch, ext=".states"):
                 want = path
                 break
     return want[:-len(".params")] + ext
+
+
+def save_data_state(prefix, epoch, data_iter):
+    """Checkpoint the input pipeline next to the model checkpoint:
+    ``data_iter.state_dict()`` is pickled into
+    ``prefix-NNNN.data`` via ``resilience.atomic_save`` (temp +
+    fsync + rename + CRC32 sidecar), so a launcher restart can
+    resume the stream at the exact batch instead of rewinding the
+    epoch (docs/data_pipeline.md).  Returns the path written."""
+    import pickle
+
+    from . import resilience
+    state = data_iter.state_dict()
+    path = f"{prefix}-{epoch:04d}.data"
+    resilience.atomic_save(path, lambda f: pickle.dump(state, f))
+    return path
+
+
+def load_data_state(prefix, epoch, data_iter, strict=False):
+    """Restore ``data_iter`` from the ``.data`` companion of the
+    checkpoint that actually loaded for ``epoch`` (resolved like the
+    optimizer ``.states`` companion, so a corrupt-params fallback
+    pairs the stream with the weights it resumed from).
+
+    Missing or corrupt data state degrades to an epoch-start resume
+    with a warning — weights are intact and rewinding one epoch of
+    *data* is safe, merely wasteful — unless ``strict``.  Returns
+    True when the state was applied."""
+    import os
+    import pickle
+    import warnings
+
+    from . import resilience
+    path = checkpoint_companion_path(prefix, epoch, ext=".data")
+    try:
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                f"no data-state companion {path}")
+        raw = resilience.read_validated_bytes(path)
+        state = resilience.decode_or_corrupt(
+            path, lambda: pickle.loads(raw))
+    except (FileNotFoundError,
+            resilience.CheckpointCorruptError) as exc:
+        if strict:
+            raise
+        warnings.warn(
+            f"data-pipeline state {path} could not be loaded "
+            f"({exc}); resuming the stream from the epoch start",
+            RuntimeWarning)
+        return False
+    data_iter.load_state_dict(state)
+    return True
 
 
 def load_checkpoint(prefix, epoch, fallback=None, return_epoch=False):

@@ -15,7 +15,8 @@ from .. import initializer as init_mod
 from .. import optimizer as opt_mod
 from ..initializer import InitDesc
 from ..model import (_create_kvstore, save_checkpoint,
-                     load_checkpoint, checkpoint_companion_path)
+                     load_checkpoint, checkpoint_companion_path,
+                     save_data_state, load_data_state)
 from ..ndarray.ndarray import NDArray
 from .base_module import BaseModule
 
@@ -378,11 +379,25 @@ class Module(BaseModule):
         mon.install(self._exec)
 
     # ------------------------------------------------------------ ckpt
-    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False,
+                        data_iter=None):
+        """Save params (+ optimizer states, + input-pipeline position
+        when ``data_iter`` is given) — every file atomically, so the
+        launcher's restart loop always finds a coherent set."""
         arg, aux = self.get_params()
         save_checkpoint(prefix, epoch, self._symbol, arg, aux)
         if save_optimizer_states:
             self.save_optimizer_states(f"{prefix}-{epoch:04d}.states")
+        if data_iter is not None:
+            save_data_state(prefix, epoch, data_iter)
+
+    @staticmethod
+    def load_data_state(prefix, epoch, data_iter, strict=False):
+        """Restore ``data_iter`` from the checkpoint's ``.data``
+        companion (see ``model.load_data_state``): the resumed stream
+        continues at the exact batch the checkpoint was taken at."""
+        return load_data_state(prefix, epoch, data_iter,
+                               strict=strict)
 
     def save_optimizer_states(self, fname):
         from .. import resilience

@@ -92,19 +92,21 @@ class MXRecordIO:
         self.open()
 
     # ------------------------------------------------------------ mgmt
-    def open(self):
+    def open(self, append=False):
         if self._lib is not None:
             if self.flag == "w":
                 self._handle = self._lib.rio_writer_open(
-                    self.uri.encode(), 0)
+                    self.uri.encode(), 1 if append else 0)
             else:
                 self._handle = self._lib.rio_reader_open(
                     self.uri.encode())
             if not self._handle:
                 raise IOError(f"cannot open {self.uri}")
         else:
-            self._fp = open(self.uri,
-                            "wb" if self.flag == "w" else "rb")
+            if self.flag == "w":
+                self._fp = open(self.uri, "ab" if append else "wb")
+            else:
+                self._fp = open(self.uri, "rb")
         self.is_open = True
 
     def close(self):
@@ -134,6 +136,18 @@ class MXRecordIO:
             pass  # interpreter teardown: ctypes may already be gone
 
     def __getstate__(self):
+        if getattr(self, "is_open", False) and self.flag == "w":
+            # commit buffered writes before the state is captured:
+            # the unpickled copy reopens the file in append mode, so
+            # everything it is supposed to continue *after* must be
+            # on disk now, not in this process's stdio buffer
+            # (close+reopen-append flushes on both backends)
+            self.close()
+            self.open(append=True)
+        # both copies of a pickled writer may eventually close();
+        # mark them so index-carrying subclasses merge with the
+        # on-disk index instead of overwriting the other copy's
+        self._forked = True
         d = dict(self.__dict__)
         d["_handle"] = None
         d["_fp"] = None
@@ -148,7 +162,12 @@ class MXRecordIO:
         self._lib = _native_lib()
         self.is_open = False
         if was_open:
-            self.open()
+            # an unpickled writer must NOT reopen with "w" semantics:
+            # that truncates the very file it was writing (fork-based
+            # DataLoader workers pickle their dataset, which may hold
+            # an open writer).  Append keeps the bytes already
+            # committed; readers reopen normally at offset 0.
+            self.open(append=self.flag == "w")
 
     # ------------------------------------------------------------ io
     def write(self, buf):
@@ -166,13 +185,35 @@ class MXRecordIO:
         if self._lib is not None:
             n = self._lib.rio_reader_next(ctypes.c_void_p(self._handle))
             if n == -1:
-                return None  # EOF
-            if n < 0:
-                raise IOError("corrupt recordio stream")
-            data = self._lib.rio_reader_data(
-                ctypes.c_void_p(self._handle))
-            return ctypes.string_at(data, n)
-        return self._py_read()
+                buf = None  # EOF
+            elif n < 0:
+                raise IOError(
+                    "corrupt recordio stream in "
+                    f"{self.uri} near offset {self.tell()} "
+                    "(bad magic or truncated record)")
+            else:
+                data = self._lib.rio_reader_data(
+                    ctypes.c_void_p(self._handle))
+                buf = ctypes.string_at(data, n)
+        else:
+            buf = self._py_read()
+        return self._maybe_inject(buf)
+
+    def _maybe_inject(self, buf):
+        """``record:read`` fault point: deterministically corrupt or
+        truncate the record payload a test asked for (kind ``error``
+        raises inside inject) — the CPU-testable stand-in for disk
+        bit-rot under a record iterator."""
+        from .resilience import faults_active, inject
+        if buf is None or not faults_active():
+            return buf
+        kind = inject("record", "read")
+        if kind == "corrupt":
+            first = buf[0] ^ 0xFF if buf else 0xFF
+            return bytes([first]) + buf[1:]
+        if kind == "truncate":
+            return buf[:len(buf) // 2]
+        return buf
 
     def tell(self):
         if self._lib is not None:
@@ -216,26 +257,67 @@ class MXRecordIO:
         in_split = False
         read_any = False
         while True:
+            at = self._fp.tell()
             hdr = self._fp.read(8)
             if len(hdr) < 8:
                 if read_any:
-                    raise IOError("corrupt recordio stream")
+                    raise IOError(
+                        "corrupt recordio stream in "
+                        f"{self.uri} near offset {at} "
+                        "(truncated record header)")
                 return None
             read_any = True
             magic, lrec = struct.unpack("<II", hdr)
             if magic != _MAGIC:
-                raise IOError("corrupt recordio stream")
+                raise IOError(
+                    "corrupt recordio stream in "
+                    f"{self.uri} near offset {at} (bad magic "
+                    f"0x{magic:08x})")
             length = lrec & ((1 << 29) - 1)
             cflag = lrec >> 29
             if in_split:
                 out += self._MAGIC_BYTES
-            out += self._fp.read(length)
+            chunk = self._fp.read(length)
+            if len(chunk) < length:
+                # a declared length past EOF: validate instead of
+                # silently returning a short record
+                raise IOError(
+                    "corrupt recordio stream in "
+                    f"{self.uri} near offset {at} (record claims "
+                    f"{length} bytes, only {len(chunk)} on disk)")
+            out += chunk
             pad = (4 - (length & 3)) & 3
             if pad:
                 self._fp.read(pad)
             if cflag in (0, 3):
                 return out
             in_split = True
+
+    def resync(self, max_scan=1 << 26):
+        """After a corrupt :meth:`read`: scan forward from the
+        current position for the next record magic and seat the
+        stream there, so a record-backed iterator can quarantine the
+        bad region and keep going (the dmlc scan-for-magic recovery).
+        Returns the new offset, or None when no further magic exists
+        within ``max_scan`` bytes.  Each failed read consumes at
+        least its header bytes, so alternating read()/resync() always
+        makes forward progress."""
+        assert self.flag == "r"
+        pos = self.tell()
+        with open(self.uri, "rb") as f:
+            f.seek(pos)
+            buf = b""
+            while f.tell() - pos <= max_scan:
+                chunk = f.read(1 << 16)
+                if not chunk:
+                    return None
+                buf = buf[-3:] + chunk  # keep the chunk-seam bytes
+                hit = buf.find(self._MAGIC_BYTES)
+                if hit >= 0:
+                    new_pos = f.tell() - len(buf) + hit
+                    self.seek(new_pos)
+                    return new_pos
+        return None
 
     def seek(self, pos):
         assert self.flag == "r"
@@ -268,9 +350,24 @@ class MXIndexedRecordIO(MXRecordIO):
     def close(self):
         if getattr(self, "flag", None) == "w" and \
                 getattr(self, "is_open", False):
+            entries = dict(self.idx)
+            if getattr(self, "_forked", False) and \
+                    os.path.exists(self.idx_path):
+                # this writer crossed a pickle boundary: the other
+                # copy may have closed first — union with its index
+                # (ours wins on conflict) so neither close clobbers
+                # the other's records
+                with open(self.idx_path) as f:
+                    for line in f:
+                        parts = line.strip().split("\t")
+                        if len(parts) == 2:
+                            k = self.key_type(parts[0])
+                            entries.setdefault(k, int(parts[1]))
+            order = sorted(entries, key=lambda k: entries[k]) \
+                if getattr(self, "_forked", False) else self.keys
             with open(self.idx_path, "w") as f:
-                for k in self.keys:
-                    f.write(f"{k}\t{self.idx[k]}\n")
+                for k in order:
+                    f.write(f"{k}\t{entries[k]}\n")
         super().close()
 
     def read_idx(self, idx):

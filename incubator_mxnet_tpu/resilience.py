@@ -40,7 +40,7 @@ import zlib
 from .utils.env import get_env
 
 __all__ = ["ResilienceError", "TransientError", "DeadlineExceededError",
-           "CollectiveAbortedError",
+           "CollectiveAbortedError", "DataPipelineError",
            "CheckpointCorruptError", "RetryPolicy", "retry_call",
            "deadline_call", "call_transient_mapped", "TRANSIENT_MARKERS",
            "JOIN_TRANSIENT_MARKERS", "decode_or_corrupt",
@@ -49,7 +49,7 @@ __all__ = ["ResilienceError", "TransientError", "DeadlineExceededError",
            "atomic_write_bytes", "checksum_path", "verify_checkpoint",
            "validate_or_raise", "read_validated_bytes",
            "start_heartbeat", "stop_heartbeat",
-           "collective_timeout"]
+           "collective_timeout", "data_timeout"]
 
 
 # ---------------------------------------------------------------------------
@@ -82,6 +82,19 @@ class CheckpointCorruptError(ResilienceError, IOError):
 
     Subclasses IOError so legacy ``except IOError`` checkpoint
     handling still catches it."""
+
+
+class DataPipelineError(ResilienceError):
+    """The input pipeline failed as a *pipeline*: a prefetch worker
+    raised or wedged, a DataLoader process died past its restart
+    budget, or a record source exceeded its bad-record budget.
+
+    Typed so training loops can tell "the data stopped" from a model
+    or collective failure — the former is usually storage/dataset
+    trouble where a restart rereads the same poison, the latter is
+    what --max-restarts exists for.  Also a RuntimeError (via
+    ResilienceError) so legacy ``except RuntimeError`` guards keep
+    working."""
 
 
 # ---------------------------------------------------------------------------
@@ -270,6 +283,15 @@ def collective_timeout():
     return get_env("MXTPU_COLLECTIVE_TIMEOUT")
 
 
+def data_timeout():
+    """Deadline for input-pipeline queue waits (MXTPU_DATA_TIMEOUT,
+    seconds; 0 disables).  Consumers of prefetch queues bound every
+    ``get()`` by this so a wedged producer surfaces as a
+    :class:`DataPipelineError` naming the stalled source instead of
+    an eternal block."""
+    return get_env("MXTPU_DATA_TIMEOUT")
+
+
 # ---------------------------------------------------------------------------
 # deterministic fault injection
 # ---------------------------------------------------------------------------
@@ -304,13 +326,15 @@ def parse_fault_spec(raw):
             raise ValueError(
                 f"bad fault spec {entry!r}: kind {kind!r} not in "
                 f"{_FAULT_KINDS}")
-        if kind in ("truncate", "corrupt") and scope != "checkpoint":
-            # data-path kinds only have an effect where a data file
-            # is written; accepting them elsewhere would validate a
-            # spec that injects nothing
+        if kind in ("truncate", "corrupt") and \
+                scope not in ("checkpoint", "record"):
+            # data-path kinds only have an effect where file bytes
+            # flow (checkpoint writes, recordio reads); accepting
+            # them elsewhere would validate a spec that injects
+            # nothing
             raise ValueError(
                 f"bad fault spec {entry!r}: kind {kind!r} only "
-                "applies to the 'checkpoint' scope")
+                "applies to the 'checkpoint' and 'record' scopes")
         if nth != "*":
             try:
                 nth = int(nth)

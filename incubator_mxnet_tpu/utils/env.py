@@ -104,3 +104,23 @@ register_env("MXTPU_HEARTBEAT_TIMEOUT", float, 60.0,
 register_env("MXTPU_CKPT_FALLBACK", bool, True,
              "on corrupt/truncated checkpoint load, fall back to the "
              "newest earlier checkpoint that validates")
+
+# Data-pipeline resilience (io/, gluon/data/; docs/data_pipeline.md).
+register_env("MXTPU_DATA_TIMEOUT", float, 600.0,
+             "wall-clock deadline (s) on input-pipeline queue waits; "
+             "a stalled prefetch worker or DataLoader raises a "
+             "diagnostic DataPipelineError naming the source instead "
+             "of blocking next() forever; 0 disables")
+register_env("MXTPU_DATA_WORKER_RESTARTS", int, 2,
+             "times a DataLoader re-dispatches the index batch of a "
+             "dead (segfaulted / OOM-killed) worker process before "
+             "raising DataPipelineError")
+register_env("MXTPU_MAX_BAD_RECORDS", int, 0,
+             "bad-record budget for record-backed iterators: corrupt "
+             "records are skipped and logged until this many have "
+             "been seen, then the iterator raises DataPipelineError; "
+             "0 (default) raises on the first bad record")
+register_env("MXTPU_DL_DEAD_GRACE", float, 60.0,
+             "seconds a multiprocess DataLoader waits for a dead "
+             "worker's in-flight batch before declaring it lost and "
+             "re-dispatching (MXTPU_DATA_WORKER_RESTARTS budget)")

@@ -8,6 +8,7 @@ parent maps them out of /dev/shm.
 import glob
 import os
 import time
+import warnings
 
 import numpy as np
 import pytest
@@ -179,11 +180,100 @@ class _DyingDataset(gluon.data.Dataset):
         return np.zeros(2, "float32")
 
 
-def test_mp_loader_dead_worker_raises_not_hangs(monkeypatch):
+def test_mp_loader_dead_worker_raises_typed_not_hangs(monkeypatch):
+    from incubator_mxnet_tpu.resilience import DataPipelineError
     monkeypatch.setenv("MXTPU_DL_DEAD_GRACE", "2")
+    monkeypatch.setenv("MXTPU_DATA_WORKER_RESTARTS", "0")
     loader = gluon.data.DataLoader(_DyingDataset(), batch_size=2,
                                    num_workers=2)
-    with pytest.raises(RuntimeError, match="worker died"):
+    start = time.monotonic()
+    # also a plain RuntimeError (legacy guards keep working)
+    with pytest.raises(DataPipelineError, match="worker died"):
+        for _ in loader:
+            pass
+    assert time.monotonic() - start < 60
+    deadline = time.time() + 5
+    while _leaked_segments() and time.time() < deadline:
+        time.sleep(0.05)
+    assert not _leaked_segments()   # dead worker's shm swept
+
+
+class _KillOnceDataset(gluon.data.Dataset):
+    """idx 3 SIGKILLs its worker — but only the first time (a marker
+    file records the casualty), so the re-dispatched batch succeeds:
+    the recovery path, not just the give-up path."""
+
+    def __init__(self, marker):
+        self._marker = marker
+
+    def __len__(self):
+        return 12
+
+    def __getitem__(self, idx):
+        if idx == 3 and not os.path.exists(self._marker):
+            with open(self._marker, "w") as f:
+                f.write(str(os.getpid()))
+            import signal
+            os.kill(os.getpid(), signal.SIGKILL)
+        return np.full((2,), idx, dtype="float32")
+
+
+def test_mp_loader_sigkilled_worker_recovers_via_redispatch(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("MXTPU_DL_DEAD_GRACE", "1")
+    monkeypatch.setenv("MXTPU_DATA_WORKER_RESTARTS", "2")
+    marker = str(tmp_path / "died")
+    loader = gluon.data.DataLoader(_KillOnceDataset(marker),
+                                   batch_size=2, num_workers=2)
+    start = time.monotonic()
+    with warnings.catch_warnings(record=True) as wl:
+        warnings.simplefilter("always")
+        batches = [b.asnumpy() for b in loader]
+    assert time.monotonic() - start < 60
+    assert os.path.exists(marker)               # a worker did die
+    assert any("re-dispatching" in str(x.message) for x in wl)
+    # every batch arrived, in order, despite the mid-epoch SIGKILL
+    got = sorted(v for b in batches for v in b[:, 0].tolist())
+    assert got == [float(i) for i in range(12)]
+    deadline = time.time() + 5
+    while _leaked_segments() and time.time() < deadline:
+        time.sleep(0.05)
+    assert not _leaked_segments()   # no orphan /dev/shm segments
+
+
+def test_short_data_timeout_does_not_preempt_redispatch(
+        tmp_path, monkeypatch):
+    # MXTPU_DATA_TIMEOUT below the dead-worker grace must not raise
+    # "pool is stalled" while the re-dispatch path is pursuing an
+    # observed respawn (review regression)
+    monkeypatch.setenv("MXTPU_DATA_TIMEOUT", "2")
+    monkeypatch.setenv("MXTPU_DL_DEAD_GRACE", "4")
+    monkeypatch.setenv("MXTPU_DATA_WORKER_RESTARTS", "2")
+    marker = str(tmp_path / "died")
+    loader = gluon.data.DataLoader(_KillOnceDataset(marker),
+                                   batch_size=2, num_workers=2)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        batches = [b.asnumpy() for b in loader]
+    got = sorted(v for b in batches for v in b[:, 0].tolist())
+    assert got == [float(i) for i in range(12)]
+
+
+class _RaisingDataset(gluon.data.Dataset):
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, idx):
+        if idx == 5:
+            raise ValueError("bad sample 5")
+        return np.zeros(2, "float32")
+
+
+def test_mp_loader_worker_exception_is_typed():
+    from incubator_mxnet_tpu.resilience import DataPipelineError
+    loader = gluon.data.DataLoader(_RaisingDataset(), batch_size=2,
+                                   num_workers=2)
+    with pytest.raises(DataPipelineError, match="bad sample 5"):
         for _ in loader:
             pass
 
@@ -217,8 +307,66 @@ class _CrashDataset(gluon.data.Dataset):
 
 def test_mp_loader_dead_worker_raises_not_hangs(monkeypatch):
     monkeypatch.setenv("MXTPU_DL_DEAD_GRACE", "6")
+    monkeypatch.setenv("MXTPU_DATA_WORKER_RESTARTS", "0")
     loader = gluon.data.DataLoader(_CrashDataset(), batch_size=2,
                                    num_workers=2)
     with pytest.raises(RuntimeError, match="worker died"):
         for _ in loader:
             pass
+
+
+class _WedgedDataset(gluon.data.Dataset):
+    def __len__(self):
+        return 4
+
+    def __getitem__(self, idx):
+        if idx == 1:
+            time.sleep(3600)     # wedged in native code / NFS stall
+        return np.zeros(2, "float32")
+
+
+def test_mp_loader_wedged_worker_bounded_by_data_timeout(monkeypatch):
+    from incubator_mxnet_tpu.resilience import DataPipelineError
+    monkeypatch.setenv("MXTPU_DATA_TIMEOUT", "3")
+    loader = gluon.data.DataLoader(_WedgedDataset(), batch_size=2,
+                                   num_workers=1)
+    start = time.monotonic()
+    with pytest.raises(DataPipelineError, match="MXTPU_DATA_TIMEOUT"):
+        for _ in loader:
+            pass
+    assert time.monotonic() - start < 30
+
+
+def test_loader_state_dict_of_armed_resume_is_not_lost():
+    # checkpointing between load_state_dict() and the first batch
+    # must re-emit the armed state, not batches_served=0 (review
+    # regression)
+    loader = gluon.data.DataLoader(_PidDataset(), batch_size=4)
+    loader.load_state_dict({"type": "DataLoader",
+                            "batches_served": 3,
+                            "epoch_rng": np.random.get_state()})
+    state = loader.state_dict()
+    assert state["batches_served"] == 3
+    assert len(list(loader)) == 3    # 6 batches - 3 served
+
+
+def test_mp_loader_state_dict_resumes_at_batch():
+    ds = _PidDataset()
+    np.random.seed(9)
+    loader = gluon.data.DataLoader(ds, batch_size=4, shuffle=True,
+                                   num_workers=2)
+    it = iter(loader)
+    for _ in range(2):
+        next(it)
+    state = loader.state_dict()
+    want = [b[0].asnumpy() for b in it]
+
+    np.random.seed(1)
+    loader2 = gluon.data.DataLoader(ds, batch_size=4, shuffle=True,
+                                    num_workers=2)
+    loader2.load_state_dict(state)
+    got = [b[0].asnumpy() for b in loader2]
+    assert len(got) == len(want) == 4
+    for a, b in zip(want, got):
+        np.testing.assert_array_equal(a, b)
+    assert not _leaked_segments()

@@ -86,6 +86,12 @@ def _worker_env(args, rank, coord, attempt):
         "MXTPU_COORD_ADDR": coord,
         "MXTPU_RESTART_ATTEMPT": str(attempt),
     }
+    if getattr(args, "data_timeout", None) is not None:
+        # input pipelines must fail before the whole job looks hung:
+        # a worker whose data stalls raises DataPipelineError (a
+        # clean, restartable exit) while its heartbeat is still
+        # beating — heartbeats only catch wedged *processes*
+        env["MXTPU_DATA_TIMEOUT"] = str(args.data_timeout)
     for kv in args.env:
         if "=" not in kv:
             raise ValueError(f"--env wants KEY=VALUE, got {kv!r}")
@@ -234,6 +240,12 @@ def main():
     ap.add_argument("--heartbeat-interval", type=float,
                     default=_env_float("MXTPU_HEARTBEAT_INTERVAL", 2.0),
                     help="seconds between worker heartbeat refreshes")
+    ap.add_argument("--data-timeout", type=float, default=None,
+                    help="export MXTPU_DATA_TIMEOUT to every worker: "
+                    "input-pipeline queue waits past this many "
+                    "seconds raise DataPipelineError (a restartable "
+                    "failure) instead of hanging; unset leaves the "
+                    "workers' own env/default")
     ap.add_argument("--max-restarts", type=int, default=0,
                     help="elastic mode: relaunch the whole job up to "
                     "N times after a worker failure (workers resume "
@@ -359,7 +371,9 @@ def main():
                 break
             print(f"launch.py: restarting job (attempt {attempt}/"
                   f"{args.max_restarts}); workers should resume from "
-                  "their last checkpoint", file=sys.stderr)
+                  "their last checkpoint (params + optimizer .states "
+                  "+ input-pipeline .data companions)",
+                  file=sys.stderr)
             rc = _run_once(make_spawners(coord_for(attempt), attempt),
                            hb_files(attempt), args.heartbeat_timeout)
         return rc
