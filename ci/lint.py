@@ -43,6 +43,25 @@ DATA_QUEUE_DIRS = (
     "incubator_mxnet_tpu/gluon/data/",
 )
 
+# Guarded training hot paths (step sentinel,
+# docs/numeric_stability.md).  In these functions an *unconditional*
+# host sync — .item()/.asscalar()/.asnumpy(), np.asarray on a device
+# value, jax.device_get — would turn every training step into a
+# device->host round trip; the sentinel's design budget is ONE scalar
+# read per MXTPU_GUARD_INTERVAL steps.  The guard-interval read
+# itself is annotated `# sync-ok: <why>` on its line.
+HOT_SYNC_FILES = (
+    "incubator_mxnet_tpu/gluon/trainer.py",
+    "incubator_mxnet_tpu/optimizer.py",
+)
+HOT_SYNC_FUNCS = {"step", "update", "__call__", "begin_step",
+                  "guarded_step_begin", "read_window_bad",
+                  "accumulate_window", "all_finite"}
+# attrs that always sync, and ones that sync only for specific roots
+SYNC_ATTRS = {"item", "asscalar", "asnumpy"}
+SYNC_ROOT_ATTRS = {("np", "asarray"), ("numpy", "asarray"),
+                   ("jax", "device_get")}
+
 # MXTPU_-prefixed tokens that are NOT environment variables (log
 # markers etc.) — exempt from the env-var documentation check.
 NON_ENV_TOKENS = {"MXTPU_KILLED"}
@@ -63,6 +82,45 @@ def _is_binary_write_open(node):
     return (isinstance(mode, ast.Constant)
             and isinstance(mode.value, str)
             and "w" in mode.value and "b" in mode.value)
+
+
+def _attr_root(node):
+    """Base Name id of an Attribute chain (``jax.x.y`` -> 'jax')."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _hot_sync_problems(path, tree, lines):
+    """Flag unconditional host syncs inside the guarded training hot
+    paths (HOT_SYNC_FILES x HOT_SYNC_FUNCS).  Lines carrying a
+    ``sync-ok`` annotation — the guard-interval read — are exempt."""
+    problems = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                or fn.name not in HOT_SYNC_FUNCS:
+            continue
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            attr = node.func.attr
+            root = _attr_root(node.func.value)
+            hit = attr in SYNC_ATTRS or (root, attr) in SYNC_ROOT_ATTRS
+            if not hit:
+                continue
+            line = lines[node.lineno - 1] \
+                if node.lineno - 1 < len(lines) else ""
+            if "sync-ok" in line:
+                continue
+            problems.append(
+                f"{path}:{node.lineno}: host sync "
+                f"'.{attr}()' in guarded hot path "
+                f"'{fn.name}' — the step sentinel budgets one "
+                "scalar device->host read per MXTPU_GUARD_INTERVAL "
+                "steps; move it behind the guard-interval read or "
+                "annotate the line with '# sync-ok: <why>'")
+    return problems
 
 
 def _imported_names(tree):
@@ -113,6 +171,9 @@ def check_file(path):
         posix.endswith(m) or (m.endswith("/") and m in posix)
         for m in CKPT_MODULES)
     in_data_queue_module = any(d in posix for d in DATA_QUEUE_DIRS)
+    if any(posix.endswith(m) for m in HOT_SYNC_FILES):
+        problems.extend(
+            _hot_sync_problems(path, tree, src.splitlines()))
 
     for node in ast.walk(tree):
         if in_ckpt_module and _is_binary_write_open(node):

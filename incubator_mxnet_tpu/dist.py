@@ -22,7 +22,7 @@ the reference's `kv.num_workers`-driven behavior carries over.
 import os
 
 __all__ = ["init", "is_initialized", "rank", "num_workers",
-           "allreduce_sum", "broadcast", "barrier"]
+           "allreduce_sum", "allreduce_max", "broadcast", "barrier"]
 
 _initialized = False
 
@@ -73,6 +73,9 @@ def init(coordinator_address=None, num_workers_=None, rank_=None):
     global _initialized
     from . import resilience
     resilience.start_heartbeat()
+    # launcher-spawned workers report divergence with a distinct exit
+    # code so launch.py's restart loop can tell it from a crash
+    resilience.install_diverged_exithook()
     import jax
     if _initialized:
         return jax.process_index()
@@ -213,6 +216,31 @@ def allreduce_sum(value):
             return jnp.asarray(gathered.sum(axis=0))
         return jax.tree_util.tree_map(red, value)
     return _guarded("allreduce", "-", body)
+
+
+def allreduce_max(value):
+    """Elementwise maximum of ``value`` across all processes.
+
+    The step sentinel's rank-consistency primitive: every rank
+    contributes its local bad-step window count and every rank
+    receives the same global verdict, so skip decisions can never
+    diverge across replicas (a rank-local skip desynchronizes
+    optimizer state — the same discipline as CollectiveAbortedError
+    for half-completed collectives).  Max — not sum — because the
+    fused/mesh paths compute a *replicated* flag: every rank
+    observes the same bad step, and summing would multiply one
+    dropped update by the world size."""
+    import jax
+    import jax.numpy as jnp
+
+    def body():
+        if jax.process_count() == 1:
+            return value
+        from jax.experimental import multihost_utils
+        gathered = multihost_utils.process_allgather(
+            jnp.asarray(value))
+        return jnp.asarray(gathered.max(axis=0))
+    return _guarded("allreduce", "max", body)
 
 
 def broadcast(value, root=0):

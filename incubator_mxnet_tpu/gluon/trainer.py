@@ -43,6 +43,17 @@ class Trainer:
             self._optimizer.set_lr_mult({p.name: p.lr_mult})
             self._optimizer.set_wd_mult({p.name: p.wd_mult})
         self._updater = opt_mod.get_updater(self._optimizer)
+        # step sentinel (docs/numeric_stability.md): guard policy and
+        # loss scaler come from the MXTPU_NONFINITE_POLICY /
+        # MXTPU_LOSS_SCALE* env knobs; both default to inert
+        from .. import resilience
+        self._scaler = opt_mod.LossScaler()
+        self._guard = resilience.NumericGuard(name="gluon.Trainer")
+        if self._scaler.dynamic and not self._guard.enabled:
+            # dynamic loss scaling IS skip-on-overflow: the scaler's
+            # overflow signal is the guard's finiteness flag, and an
+            # overflow step must not reach the weights
+            self._guard.policy = "skip"
         self._kvstore_spec = kvstore
         self._kvstore = None
         self._kv_initialized = False
@@ -70,6 +81,19 @@ class Trainer:
     @property
     def learning_rate(self):
         return self._optimizer.lr
+
+    @property
+    def loss_scale(self):
+        """Current loss scale — when loss scaling is enabled
+        (MXTPU_LOSS_SCALE*), multiply the loss by this before
+        ``backward()``; ``step()`` rescales the gradients back."""
+        return self._scaler.scale
+
+    @property
+    def guard(self):
+        """The step sentinel's NumericGuard (skip/bad-step counters,
+        host-read accounting)."""
+        return self._guard
 
     def set_learning_rate(self, lr):
         self._optimizer.set_learning_rate(lr)
@@ -111,12 +135,22 @@ class Trainer:
         self._fstate = self._fopt.init(
             {p.name: p.data()._data for p in self._params})
 
-    def _fused_variant(self, missing_names):
+    def _fused_variant(self, missing_names, guarded=False,
+                       select=False):
         """Compiled update skipping ``missing_names`` (stale grads):
         the reference leaves both weight and optimizer state of a
         grad-less parameter untouched, so the fused step restores
-        those leaves after the whole-tree update."""
-        fn = self._fused_update.get(missing_names)
+        those leaves after the whole-tree update.
+
+        With ``guarded=True`` the executable additionally reduces
+        the gradients to one finiteness scalar, returned as a third
+        output for the guard's interval read.  ``select=True``
+        (policies that drop bad updates — skip/raise) further routes
+        the whole update through a ``where(finite, new, old)`` select
+        so a bad step never reaches weights or optimizer state, on
+        device, with zero host syncs; under policy=warn the select
+        stays off — warn's contract is to apply the update anyway."""
+        fn = self._fused_update.get((missing_names, guarded, select))
         if fn is not None:
             return fn
         opt, fopt = self._optimizer, self._fopt
@@ -138,10 +172,18 @@ class Trainer:
                                       for n in missing_names if n in v}}
                              if isinstance(v, dict) else v)
                          for k, v in new_s.items()}
-            return new_p, new_s
+            if not guarded:
+                return new_p, new_s
+            finite = jnp.asarray(
+                opt_mod.all_finite(list(grads.values())))
+            if not select:
+                return new_p, new_s, finite
+            sel = lambda new, old: jax.tree_util.tree_map(
+                lambda a, b: jnp.where(finite, a, b), new, old)
+            return sel(new_p, params), sel(new_s, state), finite
 
         fn = jax.jit(upd, donate_argnums=(0, 2))
-        self._fused_update[missing_names] = fn
+        self._fused_update[(missing_names, guarded, select)] = fn
         return fn
 
     def _fused_active(self):
@@ -153,12 +195,23 @@ class Trainer:
 
     def step(self, batch_size, ignore_stale_grad=False):
         """Apply one optimizer step scaled by 1/batch_size
-        (ref: trainer.py step)."""
+        (ref: trainer.py step).
+
+        With the step sentinel on (MXTPU_NONFINITE_POLICY=skip, or
+        dynamic loss scaling), a step whose gradients are non-finite
+        is dropped whole: weights, optimizer state, and the
+        LR-schedule step count stay untouched, and in multi-rank runs
+        the skip decision is allreduced so every replica agrees."""
         if not self._kv_initialized:
             self._init_kvstore()
         if self._fused_update is None:
             self._init_fused()
         self._optimizer.rescale_grad = self._scale / batch_size
+        if self._scaler.active:
+            # gradients were computed on a loss multiplied by
+            # self.loss_scale; scale them back in the same fused
+            # rescale the batch-size division uses
+            self._optimizer.rescale_grad /= self._scaler.scale
 
         missing = [p for p in self._params if p._grad is None]
         if missing and not ignore_stale_grad:
@@ -166,22 +219,53 @@ class Trainer:
                 f"Gradient of Parameter `{missing[0].name}` not set; "
                 "call backward first, or set ignore_stale_grad=True")
 
+        guarded = self._guard.enabled
         if self._fused_active():
             params = {p.name: p.data()._data for p in self._params}
             grads = {p.name: (p._grad._data if p._grad is not None
                               else jnp.zeros_like(p.data()._data))
                      for p in self._params}
+            if guarded:
+                poison = opt_mod.grad_poison()
+                if poison is not None:
+                    first = next(iter(grads))
+                    grads[first] = grads[first] * poison
             fn = self._fused_variant(
-                tuple(sorted(p.name for p in missing)))
-            new_p, self._fstate = fn(
+                tuple(sorted(p.name for p in missing)), guarded,
+                self._guard.drops_updates)
+            out = fn(
                 params, grads, self._fstate,
                 jnp.asarray(self._optimizer.rescale_grad, jnp.float32),
                 jnp.asarray(foptim.scheduled_lr(self._optimizer),
                             jnp.float32))
+            if guarded:
+                new_p, self._fstate, flag = out
+            else:
+                new_p, self._fstate = out
             for p in self._params:
                 p._data._data = new_p[p.name]
+            if guarded:
+                due = self._guard.begin_step()
+                opt_mod.accumulate_window(self._guard, flag)
+                if due:
+                    bad = opt_mod.read_window_bad(self._guard)
+                    if bad and self._guard.drops_updates:
+                        # the in-jit select already dropped those
+                        # updates on device; un-advance the LR
+                        # schedule by the exact count (before record,
+                        # which may raise under policy=raise)
+                        self._optimizer.num_update -= bad
+                    self._scaler.update(overflow=bad > 0)
+                    self._guard.record(bad == 0,
+                                       dropped=max(bad, 1))
             return
 
+        if guarded:
+            grads = [p._grad for p in self._params
+                     if p._grad is not None]
+            if not opt_mod.guarded_step_begin(self._guard,
+                                              self._scaler, grads):
+                return
         for i, p in enumerate(self._params):
             if p._grad is None:
                 continue

@@ -36,13 +36,28 @@ def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
     return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
 
 
-def clip_global_norm(arrays, max_norm):
-    """(ref: utils.py clip_global_norm)"""
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """(ref: utils.py clip_global_norm)
+
+    Returns the computed global norm.  Non-finite-safe: when the
+    norm is NaN/Inf (one bad gradient) and ``check_isfinite``, the
+    arrays are left untouched and a warning is raised — scaling by a
+    non-finite factor would turn EVERY gradient to NaN, converting
+    one bad array into a fully poisoned step.  Callers should test
+    ``math.isfinite(norm)`` and skip the update (or let the step
+    sentinel do it — docs/numeric_stability.md)."""
+    import warnings
     total = 0.0
     for a in arrays:
         n = a.norm().asscalar()
         total += float(n) ** 2
     total = math.sqrt(total)
+    if check_isfinite and not math.isfinite(total):
+        warnings.warn(
+            f"clip_global_norm: non-finite total norm ({total}); "
+            "arrays left unscaled — check the norm and skip this "
+            "update", RuntimeWarning)
+        return total
     scale = max_norm / (total + 1e-8)
     if scale < 1.0:
         for a in arrays:

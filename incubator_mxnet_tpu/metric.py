@@ -57,6 +57,31 @@ class EvalMetric:
     def reset(self):
         self.num_inst = 0
         self.sum_metric = 0.0
+        self.num_nonfinite = 0          # excluded bad batches
+        self._nonfinite_warned = False
+
+    def _include(self, sum_inc, num_inc):
+        """Guarded accumulate into the running sums.
+
+        A non-finite increment — the footprint of a NaN/Inf pred or
+        label batch — is *excluded* (counted in ``num_nonfinite``,
+        warned once) instead of being added: one bad batch must not
+        turn every subsequent ``get()`` into NaN for the rest of the
+        epoch.  Returns True when the increment was applied."""
+        if not np.all(np.isfinite(sum_inc)):
+            self.num_nonfinite += 1
+            if not self._nonfinite_warned:
+                import warnings
+                warnings.warn(
+                    f"metric {self.name}: non-finite batch update "
+                    f"({sum_inc}) excluded from the running sum; "
+                    "further exclusions counted in num_nonfinite "
+                    "(warned once)", RuntimeWarning)
+                self._nonfinite_warned = True
+            return False
+        self.sum_metric += sum_inc
+        self.num_inst += num_inc
+        return True
 
     def update(self, labels, preds):
         raise NotImplementedError
@@ -218,8 +243,7 @@ class Perplexity(EvalMetric):
                 num -= ignore.sum()
             loss -= np.log(np.maximum(probs, 1e-10)).sum()
             num += len(label)
-        self.sum_metric += loss
-        self.num_inst += num
+        self._include(loss, num)
 
     def get(self):
         if self.num_inst == 0:
@@ -236,8 +260,7 @@ class MAE(EvalMetric):
         for label, pred in zip(labels, preds):
             label, pred = _as_np(label), _as_np(pred)
             label = label.reshape(pred.shape)
-            self.sum_metric += np.abs(label - pred).mean()
-            self.num_inst += 1
+            self._include(np.abs(label - pred).mean(), 1)
 
 
 @register("mse")
@@ -249,8 +272,7 @@ class MSE(EvalMetric):
         for label, pred in zip(labels, preds):
             label, pred = _as_np(label), _as_np(pred)
             label = label.reshape(pred.shape)
-            self.sum_metric += ((label - pred) ** 2).mean()
-            self.num_inst += 1
+            self._include(((label - pred) ** 2).mean(), 1)
 
 
 @register("rmse")
@@ -262,8 +284,7 @@ class RMSE(EvalMetric):
         for label, pred in zip(labels, preds):
             label, pred = _as_np(label), _as_np(pred)
             label = label.reshape(pred.shape)
-            self.sum_metric += np.sqrt(((label - pred) ** 2).mean())
-            self.num_inst += 1
+            self._include(np.sqrt(((label - pred) ** 2).mean()), 1)
 
 
 @register("ce", aliases=["cross-entropy"])
@@ -279,8 +300,7 @@ class CrossEntropy(EvalMetric):
             label, pred = _as_np(label), _as_np(pred)
             label = label.reshape(-1).astype("int32")
             prob = pred[np.arange(len(label)), label]
-            self.sum_metric += (-np.log(prob + self.eps)).sum()
-            self.num_inst += len(label)
+            self._include((-np.log(prob + self.eps)).sum(), len(label))
 
 
 @register("nll_loss")
@@ -301,8 +321,7 @@ class PearsonCorrelation(EvalMetric):
             label, pred = _as_np(label).reshape(-1), \
                 _as_np(pred).reshape(-1)
             if len(label) > 1:
-                self.sum_metric += np.corrcoef(label, pred)[0, 1]
-                self.num_inst += 1
+                self._include(np.corrcoef(label, pred)[0, 1], 1)
 
 
 @register("loss")
@@ -315,8 +334,7 @@ class Loss(EvalMetric):
     def update(self, _, preds):
         for pred in preds:
             pred = _as_np(pred)
-            self.sum_metric += pred.sum()
-            self.num_inst += pred.size
+            self._include(pred.sum(), pred.size)
 
 
 class CustomMetric(EvalMetric):
@@ -336,11 +354,9 @@ class CustomMetric(EvalMetric):
             reval = self._feval(_as_np(label), _as_np(pred))
             if isinstance(reval, tuple):
                 s, n = reval
-                self.sum_metric += s
-                self.num_inst += n
+                self._include(s, n)
             else:
-                self.sum_metric += reval
-                self.num_inst += 1
+                self._include(reval, 1)
 
 
 def np_metric(numpy_feval, name=None, allow_extra_outputs=False):

@@ -261,8 +261,11 @@ class FeedForward:
     # ------------------------------------------------------------ train
     def fit(self, X, y=None, eval_data=None, eval_metric="acc",
             epoch_end_callback=None, batch_end_callback=None,
-            kvstore="local", logger=None):
-        """(ref: model.py FeedForward.fit:609)"""
+            kvstore="local", logger=None, checkpoint_prefix=None):
+        """(ref: model.py FeedForward.fit:609)
+
+        ``checkpoint_prefix`` arms the step sentinel's divergence
+        rollback, exactly as in ``BaseModule.fit``."""
         import logging as _logging
 
         from . import initializer as init_mod
@@ -283,6 +286,7 @@ class FeedForward:
                 aux_params=self.aux_params,
                 allow_missing=self.arg_params is not None,
                 begin_epoch=self.begin_epoch,
+                checkpoint_prefix=checkpoint_prefix,
                 # num_epoch is the END epoch (reference semantics):
                 # a loaded model with begin_epoch=N continues for at
                 # least one epoch unless told otherwise

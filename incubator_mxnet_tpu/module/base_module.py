@@ -10,6 +10,7 @@ from .. import initializer as init_mod
 from .. import metric as metric_mod
 from ..io.io import DataBatch
 from ..model import BatchEndParam
+from ..resilience import DivergedError
 
 __all__ = ["BaseModule"]
 
@@ -153,8 +154,18 @@ class BaseModule:
             initializer=None, arg_params=None,
             aux_params=None, allow_missing=False, force_rebind=False,
             force_init=False, begin_epoch=0, num_epoch=None,
-            validation_metric=None, monitor=None):
-        """Train on a data iterator (ref: base_module.py fit:376)."""
+            validation_metric=None, monitor=None,
+            checkpoint_prefix=None):
+        """Train on a data iterator (ref: base_module.py fit:376).
+
+        ``checkpoint_prefix`` arms the divergence rollback of the
+        step sentinel (docs/numeric_stability.md): when the guarded
+        update path raises ``DivergedError`` (MXTPU_MAX_BAD_STEPS
+        consecutive non-finite steps), fit restores the newest valid
+        ``prefix-NNNN.params`` checkpoint — params, optimizer
+        ``.states``, and the ``.data`` input-pipeline companion, so a
+        relaunch resumes at the right batch — before re-raising for
+        the launcher restart loop."""
         assert num_epoch is not None, "num_epoch must be given"
         initializer = initializer or init_mod.Uniform(0.01)
         self.bind(data_shapes=train_data.provide_data,
@@ -173,6 +184,23 @@ class BaseModule:
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
 
+        try:
+            self._fit_epochs(train_data, eval_data, eval_metric,
+                             epoch_end_callback, batch_end_callback,
+                             eval_end_callback,
+                             eval_batch_end_callback, begin_epoch,
+                             num_epoch, validation_metric, monitor)
+        except DivergedError:
+            if checkpoint_prefix is not None:
+                self.rollback_checkpoint(checkpoint_prefix,
+                                         data_iter=train_data)
+            raise
+
+    def _fit_epochs(self, train_data, eval_data, eval_metric,
+                    epoch_end_callback, batch_end_callback,
+                    eval_end_callback, eval_batch_end_callback,
+                    begin_epoch, num_epoch, validation_metric,
+                    monitor):
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
@@ -209,6 +237,62 @@ class BaseModule:
                 for name, val in res:
                     self.logger.info("Epoch[%d] Validation-%s=%f",
                                      epoch, name, val)
+
+    # ------------------------------------------------------------ rollback
+    def rollback_checkpoint(self, prefix, data_iter=None):
+        """Restore the newest valid ``prefix-NNNN.params`` checkpoint
+        into this (bound) module after divergence: parameters, the
+        optimizer ``.states`` companion (degrading to fresh state
+        with a warning when missing/corrupt — same contract as
+        resume), and the ``.data`` input-pipeline companion when
+        ``data_iter`` supports it, so the stream resumes at the batch
+        the checkpoint was taken at.  Returns the epoch restored, or
+        None when no checkpoint validates (params left as they are —
+        the caller's re-raise still hands the decision to the
+        launcher)."""
+        import warnings
+
+        from ..model import (_checkpoint_epochs, load_checkpoint,
+                             checkpoint_companion_path,
+                             load_data_state)
+        from ..resilience import CheckpointCorruptError
+        epochs = _checkpoint_epochs(prefix)
+        if not epochs:
+            warnings.warn(
+                f"divergence rollback: no checkpoints found under "
+                f"prefix {prefix!r}; parameters left as-is",
+                RuntimeWarning)
+            return None
+        newest = epochs[0][0]
+        try:
+            _, arg_params, aux_params, eff = load_checkpoint(
+                prefix, newest, return_epoch=True)
+        except CheckpointCorruptError as exc:
+            warnings.warn(
+                f"divergence rollback: no checkpoint under prefix "
+                f"{prefix!r} validates ({exc}); parameters left "
+                "as-is", RuntimeWarning)
+            return None
+        self.set_params(arg_params, aux_params, force_init=True)
+        if self.optimizer_initialized and \
+                hasattr(self, "load_optimizer_states"):
+            states = checkpoint_companion_path(prefix, eff)
+            try:
+                self.load_optimizer_states(states)
+            except (FileNotFoundError, CheckpointCorruptError) as exc:
+                warnings.warn(
+                    f"divergence rollback: optimizer states {states} "
+                    f"could not be loaded ({exc}); continuing with "
+                    "the diverged optimizer state replaced by the "
+                    "restored weights only", RuntimeWarning)
+        if data_iter is not None and \
+                hasattr(data_iter, "load_state_dict"):
+            load_data_state(prefix, eff, data_iter, strict=False)
+        warnings.warn(
+            f"training diverged; rolled back to checkpoint epoch "
+            f"{eff} of prefix {prefix!r} (params + optimizer + "
+            "data-iterator state)", RuntimeWarning)
+        return eff
 
     def install_monitor(self, mon):
         raise NotImplementedError

@@ -42,6 +42,7 @@ class Module(BaseModule):
         self._exec = None
         self._optimizer = None
         self._updater = None
+        self._guard = None       # step sentinel (MXTPU_NONFINITE_POLICY)
         self._kvstore = None
         self._update_on_kvstore = False
         self._data_shapes = None
@@ -143,6 +144,13 @@ class Module(BaseModule):
             _fill(name, self._exec.arg_dict[name], arg_params)
         for name in self._aux_names:
             _fill(name, self._exec.aux_dict[name], aux_params)
+        if self._mesh_step is not None:
+            # the exec dicts are now the source of truth (set_params
+            # mid-training, divergence rollback): the mesh step must
+            # re-pull them before its next fused step, and a pending
+            # sync from the step must not clobber them
+            self._mesh_dirty = False
+            self._mesh_stale = True
         self.params_initialized = True
 
     def get_params(self):
@@ -183,6 +191,12 @@ class Module(BaseModule):
         self._kvstore = kv
         self._update_on_kvstore = update_on_kvstore and kv is not None
         self._updater = None
+        # step sentinel (docs/numeric_stability.md): armed by
+        # MXTPU_NONFINITE_POLICY; the Module path has no user-scaled
+        # loss, so no LossScaler here (that is the gluon Trainer's)
+        from ..resilience import NumericGuard
+        guard = NumericGuard(name="Module")
+        self._guard = guard if guard.enabled else None
         if use_mesh_step:
             self._init_mesh_step()
         if kv is not None:
@@ -191,7 +205,10 @@ class Module(BaseModule):
             if self._update_on_kvstore:
                 kv.set_optimizer(self._optimizer)
         if not self._update_on_kvstore and not use_mesh_step:
-            self._updater = opt_mod.get_updater(optimizer)
+            self._updater = opt_mod.GuardedUpdater(
+                optimizer, guard=self._guard) \
+                if self._guard is not None \
+                else opt_mod.get_updater(optimizer)
         self.optimizer_initialized = True
         states = getattr(self, "_preload_opt_states", None)
         if states:
@@ -252,7 +269,10 @@ class Module(BaseModule):
             self._symbol, pvals, aux_vals, input_names,
             optimizer=fopt, mesh=mesh,
             rescale_grad=getattr(opt, "rescale_grad", 1.0),
-            lr_mults=lr_mults, wd_mults=wd_mults)
+            lr_mults=lr_mults, wd_mults=wd_mults,
+            numeric_guard=self._guard is not None,
+            guard_select=self._guard is not None
+            and self._guard.drops_updates)
 
     def _sync_mesh_params(self):
         """Pull owned copies from the mesh step back into the
@@ -337,19 +357,63 @@ class Module(BaseModule):
 
     def update(self):
         """(ref: module.py update:619 / model.py
-        _update_params_on_kvstore:105)"""
+        _update_params_on_kvstore:105)
+
+        Step sentinel (docs/numeric_stability.md): with
+        MXTPU_NONFINITE_POLICY armed, the step's gradients reduce to
+        one fused finiteness scalar, host-read every
+        MXTPU_GUARD_INTERVAL steps; a bad step is skipped whole
+        (weights, optimizer state, LR-schedule count), and
+        MXTPU_MAX_BAD_STEPS consecutive bad steps raise
+        DivergedError for fit's checkpoint rollback."""
         assert self.optimizer_initialized
         if self._mesh_step is not None:
             if self._mesh_pending:
-                # the optimizer already ran inside the fused mesh step
+                # the optimizer already ran inside the fused mesh
+                # step; the guarded build protected params/state on
+                # device (in-jit select) — the host only consumes
+                # the flag on due steps for policy and divergence
+                # accounting
                 self._mesh_pending = False
+                if self._guard is not None:
+                    due = self._guard.begin_step()
+                    opt_mod.accumulate_window(
+                        self._guard, self._mesh_step.last_finite)
+                    if due:
+                        bad = opt_mod.read_window_bad(self._guard)
+                        if bad and self._guard.drops_updates:
+                            # those updates were dropped on device;
+                            # keep the LR schedule in step with the
+                            # weights (exact count, before record —
+                            # policy=raise raises there)
+                            self._optimizer.num_update -= bad
+                        self._guard.record(bad == 0,
+                                           dropped=max(bad, 1))
                 return
             # manual forward/backward loop with kvstore='tpu': apply
             # the eager updater so update() is never a silent no-op
             if self._updater is None:
-                self._updater = opt_mod.get_updater(self._optimizer)
+                self._updater = opt_mod.GuardedUpdater(
+                    self._optimizer, guard=self._guard) \
+                    if self._guard is not None \
+                    else opt_mod.get_updater(self._optimizer)
             self._sync_mesh_params()
             self._mesh_stale = True
+        if self._guard is not None:
+            grads = [g for g in
+                     (self._exec.grad_dict.get(n)
+                      for n in self._param_names) if g is not None]
+            if isinstance(self._updater, opt_mod.GuardedUpdater):
+                proceed = self._updater.begin_step(grads)
+            else:
+                # update_on_kvstore: the optimizer runs inside the
+                # kvstore, so guard the step before any push — the
+                # skip must also cover the collectives (rank-
+                # consistent via the allreduced flag)
+                proceed = opt_mod.guarded_step_begin(
+                    self._guard, None, grads)
+            if not proceed:
+                return
         for i, name in enumerate(self._param_names):
             grad = self._exec.grad_dict.get(name)
             if grad is None:  # fixed / grad_req=null parameters

@@ -16,13 +16,38 @@ the executor monitor callback.  Two hooks here:
 """
 import re
 
-__all__ = ["Monitor"]
+import numpy as np
+
+__all__ = ["Monitor", "nonfinite_count"]
 
 _active_monitor = None
 
 
 def _default_stat(x):
-    return float(abs(x).mean())
+    """Mean |x| over the FINITE elements — NaN-tolerant, so one op
+    emitting a few NaNs still reports a meaningful magnitude for the
+    rest (all-non-finite or empty returns nan).  Pair with
+    :func:`nonfinite_count` to localize which op first went bad."""
+    x = np.asarray(x)
+    if x.dtype.kind not in "fc":
+        return float(np.abs(x).mean()) if x.size else float("nan")
+    finite = np.isfinite(x)
+    if not finite.any():
+        return float("nan")
+    return float(np.abs(x[finite]).mean())
+
+
+def nonfinite_count(x):
+    """Stat func counting non-finite elements per op output.
+
+    Install as ``Monitor(stat_func=nonfinite_count)`` to localize the
+    op that FIRST produced a NaN/Inf — the rows upstream of the
+    poison read 0, everything downstream is contaminated.  Integer
+    outputs are always 0 (finite by construction)."""
+    x = np.asarray(x)
+    if x.dtype.kind not in "fc":
+        return 0
+    return int(x.size - np.count_nonzero(np.isfinite(x)))
 
 
 class Monitor:

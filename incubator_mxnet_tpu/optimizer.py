@@ -17,7 +17,11 @@ from .utils.registry import get_registry
 
 __all__ = ["Optimizer", "SGD", "NAG", "SGLD", "DCASGD", "Adam", "AdaGrad",
            "RMSProp", "AdaDelta", "Ftrl", "Adamax", "Nadam", "Signum",
-           "Test", "Updater", "get_updater", "create", "register"]
+           "Test", "Updater", "GuardedUpdater", "LossScaler",
+           "all_finite", "grad_poison",
+           "accumulate_window", "read_window_bad",
+           "guarded_step_begin",
+           "get_updater", "create", "register"]
 
 _REG = get_registry("optimizer")
 register = _REG.register
@@ -520,3 +524,209 @@ class Updater:
 
 def get_updater(optimizer):
     return Updater(optimizer)
+
+
+# ---------------------------------------------------------------------------
+# training-step sentinel: fused finiteness guard + dynamic loss scale
+# (docs/numeric_stability.md)
+# ---------------------------------------------------------------------------
+
+# gradient poison applied by the grad:nonfinite injection scope
+_POISON = {"nan": float("nan"), "inf": float("inf")}
+
+
+def all_finite(arrays):
+    """Reduce a whole step's gradients to ONE on-device finiteness
+    scalar (0-d bool array) — no host sync happens here.
+
+    Each float leaf contributes an ``isfinite().all()`` reduction
+    AND-ed into the scalar; XLA fuses the chain, and on TPU the
+    device->host cost is paid only when (and as often as) the caller
+    reads the scalar — once per MXTPU_GUARD_INTERVAL steps.  Integer
+    leaves are skipped (always finite); an empty/None-only list
+    returns plain True."""
+    import jax.numpy as jnp
+    acc = None
+    for a in arrays:
+        if a is None:
+            continue
+        d = a._data if isinstance(a, NDArray) else jnp.asarray(a)
+        if not jnp.issubdtype(d.dtype, jnp.floating):
+            continue
+        f = jnp.isfinite(d).all()
+        acc = f if acc is None else acc & f
+    return True if acc is None else acc
+
+
+def grad_poison():
+    """Fire the ``grad:nonfinite`` injection scope; returns the
+    poison multiplier (nan/inf) due on this step, or None.  The one
+    definition all guarded update paths share — eager updaters apply
+    it to a real gradient array, the fused mesh step feeds it in as
+    a traced multiplier."""
+    from . import resilience
+    if not resilience.faults_active():
+        return None
+    return _POISON.get(resilience.inject("grad", "nonfinite"))
+
+
+def accumulate_window(guard, flag):
+    """Fold one step's finiteness scalar into the guard's on-device
+    bad-step counter — a tiny device add, NO host sync.
+
+    This is what makes MXTPU_GUARD_INTERVAL > 1 sound: every step's
+    flag lands in the accumulator, so a bad step between host reads
+    is still *observed* at the next read (as a nonzero count) rather
+    than silently missed.  The counter lives on the guard object but
+    all jax work happens here — resilience.py stays import-light."""
+    import jax.numpy as jnp
+    bad = jnp.asarray(jnp.logical_not(flag), jnp.int32)
+    pending = getattr(guard, "_window_bad", None)
+    guard._window_bad = bad if pending is None else pending + bad
+
+
+def read_window_bad(guard):
+    """Host-read and reset the guard's accumulated bad-step count —
+    the sentinel's ONE device->host transfer per guard interval.
+
+    Multi-rank: the count is allreduce-MAXed first so every rank
+    reaches the same verdict (and the same num_update compensation),
+    keeping skip decisions rank-consistent.  Max because the
+    fused/mesh paths compute a replicated flag — every rank counts
+    the same bad step, and a sum would multiply one dropped update
+    by the world size; for rank-asymmetric eager observations max is
+    the worst rank's count, still nonzero whenever any rank saw a
+    bad step."""
+    pending = getattr(guard, "_window_bad", None)
+    guard._window_bad = None
+    if pending is None:
+        return 0
+    from . import dist
+    if dist.is_initialized() and dist.num_workers() > 1:
+        pending = dist.allreduce_max(pending)
+    return int(pending)  # sync-ok: the one guard-interval host read
+
+
+def guarded_step_begin(guard, scaler, grads):
+    """One skip-step decision for an eager update path.
+
+    Fires the ``grad:nonfinite`` injection scope (poisoning a real
+    gradient so an unguarded run genuinely diverges), folds the
+    fused all-params finiteness scalar into the guard's on-device
+    window counter, and on due steps host-reads the accumulated
+    bad count (one scalar per MXTPU_GUARD_INTERVAL), feeds the loss
+    scaler's overflow signal, and consults the guard.  Returns True
+    to apply this step's updates, False to skip them entirely (no
+    weight/optimizer-state/step-count advance)."""
+    if not guard.enabled:
+        return True
+    poison = grad_poison()
+    if poison is not None and grads:
+        grads[0] *= poison
+    due = guard.begin_step()
+    accumulate_window(guard, all_finite(grads))
+    if not due:
+        return True
+    bad = read_window_bad(guard)
+    if scaler is not None:
+        scaler.update(overflow=bad > 0)
+    # dropped=1: on an eager path only the CURRENT step is actually
+    # withheld — with interval > 1, earlier bad steps in the window
+    # were already applied (the documented eager exposure), so
+    # counting them as skipped would overstate the protection
+    return guard.record(bad == 0) != "skip"
+
+
+class LossScaler:
+    """Dynamic loss scale (the reference's AMP GradScaler role).
+
+    Training loops multiply the loss by :attr:`scale` before backward
+    (gluon: ``Trainer.loss_scale``); ``Trainer.step`` folds ``1/scale``
+    into ``rescale_grad`` so updates see true-magnitude gradients.
+    With ``MXTPU_LOSS_SCALE_DYNAMIC`` the scale backs off by
+    ``MXTPU_LOSS_SCALE_BACKOFF`` on an overflow (non-finite) step and
+    grows by ``MXTPU_LOSS_SCALE_GROWTH`` after
+    ``MXTPU_LOSS_SCALE_WINDOW`` consecutive good steps, capped at
+    ``MXTPU_LOSS_SCALE_MAX``.  The overflow signal comes from the
+    step sentinel's finiteness scalar, so dynamic scaling adds no
+    extra device->host reads."""
+
+    def __init__(self, init_scale=None, dynamic=None, growth=None,
+                 backoff=None, window=None, max_scale=None):
+        from .utils.env import get_env
+        self.scale = float(init_scale if init_scale is not None
+                           else get_env("MXTPU_LOSS_SCALE"))
+        self.dynamic = bool(dynamic if dynamic is not None
+                            else get_env("MXTPU_LOSS_SCALE_DYNAMIC"))
+        self.growth = float(growth if growth is not None
+                            else get_env("MXTPU_LOSS_SCALE_GROWTH"))
+        self.backoff = float(backoff if backoff is not None
+                             else get_env("MXTPU_LOSS_SCALE_BACKOFF"))
+        self.window = int(window if window is not None
+                          else get_env("MXTPU_LOSS_SCALE_WINDOW"))
+        self.max_scale = float(max_scale if max_scale is not None
+                               else get_env("MXTPU_LOSS_SCALE_MAX"))
+        self._good_steps = 0
+        self.num_backoffs = 0
+        self.num_growths = 0
+
+    @property
+    def active(self):
+        """Whether loss scaling changes anything (scale != 1 or
+        dynamic adjustment on)."""
+        return self.dynamic or self.scale != 1.0
+
+    def update(self, overflow):
+        """Consume one step's overflow signal; returns the scale to
+        use for the *next* step."""
+        if not self.dynamic:
+            return self.scale
+        if overflow:
+            self.scale = max(self.scale * self.backoff, 1.0)
+            self._good_steps = 0
+            self.num_backoffs += 1
+        else:
+            self._good_steps += 1
+            if self._good_steps >= self.window:
+                self.scale = min(self.scale * self.growth,
+                                 self.max_scale)
+                self._good_steps = 0
+                self.num_growths += 1
+        return self.scale
+
+    def state_dict(self):
+        return {"scale": self.scale, "good_steps": self._good_steps}
+
+    def load_state_dict(self, state):
+        self.scale = float(state["scale"])
+        self._good_steps = int(state.get("good_steps", 0))
+
+
+class GuardedUpdater(Updater):
+    """Skip-step-aware :class:`Updater`.
+
+    Callers invoke :meth:`begin_step` ONCE per step with the step's
+    full gradient list; when it returns False every per-index
+    ``__call__`` of that step is a no-op — weights, optimizer state,
+    and the step count (``num_update``, hence the LR schedule) stay
+    exactly as they were, as if the bad batch never happened."""
+
+    def __init__(self, optimizer, guard=None, scaler=None):
+        super().__init__(optimizer)
+        from . import resilience
+        self.guard = guard if guard is not None \
+            else resilience.NumericGuard(name="Updater")
+        self.scaler = scaler
+        self._skip = False
+
+    def begin_step(self, grads):
+        """Open a step over ``grads`` (list of NDArrays); returns
+        True to proceed.  See :func:`guarded_step_begin`."""
+        self._skip = not guarded_step_begin(self.guard, self.scaler,
+                                            grads)
+        return not self._skip
+
+    def __call__(self, index, grad, weight):
+        if self._skip:
+            return
+        super().__call__(index, grad, weight)

@@ -45,8 +45,24 @@ class SymbolTrainStep:
     def __init__(self, symbol, param_vals, aux_vals, input_names,
                  optimizer="sgd", optimizer_params=None, mesh=None,
                  rescale_grad=1.0, lr_mults=None, wd_mults=None,
-                 batch_axis=0):
+                 batch_axis=0, numeric_guard=False,
+                 guard_select=None):
         self.mesh = mesh if mesh is not None else make_mesh()
+        # numeric_guard=True compiles the step-sentinel variant: the
+        # gradients reduce to one in-jit finiteness scalar
+        # (optimizer.all_finite), exposed as ``last_finite`` for the
+        # host's guard-interval read.  With ``guard_select`` (default
+        # = guarded; pass False for policy=warn, whose contract is to
+        # apply bad updates) the whole update — params, aux,
+        # optimizer state — additionally goes through a
+        # where(finite, new, old) select, so EVERY step is protected
+        # on device.  A traced ``poison`` multiplier carries the
+        # grad:nonfinite fault injection without recompiles
+        # (docs/numeric_stability.md).
+        self._guarded = bool(numeric_guard)
+        self._guard_select = self._guarded if guard_select is None \
+            else bool(guard_select)
+        self.last_finite = None
         self._run = build_graph_fn(symbol)
         self._param_names = tuple(sorted(param_vals))
         self._input_names = tuple(input_names)
@@ -79,8 +95,10 @@ class SymbolTrainStep:
         pnames = self._param_names
         scale = self.rescale_grad
         lr_mults, wd_mults = self._lr_mults, self._wd_mults
+        guarded = self._guarded
+        guard_select = self._guard_select
 
-        def step(params, aux, opt_state, inputs, rng, lr):
+        def step(params, aux, opt_state, inputs, rng, lr, poison):
             def inner(pvals):
                 merged = dict(inputs)
                 merged.update(zip(pnames, pvals))
@@ -96,12 +114,27 @@ class SymbolTrainStep:
                       for k, v in aux_upd.items()}
             (gvals,) = vjp((cts, aux_ct))
             grads = dict(zip(pnames, gvals))
+            if guarded:
+                grads = {n: g * poison.astype(g.dtype)
+                         for n, g in grads.items()}
             new_params, new_opt = opt.update(
                 params, grads, opt_state, scale=scale, lr=lr,
                 lr_mults=lr_mults, wd_mults=wd_mults)
             new_aux = dict(aux)
             new_aux.update(aux_upd)
-            return new_params, new_aux, new_opt, outs
+            if not guarded:
+                return new_params, new_aux, new_opt, outs, True
+            from ..optimizer import all_finite
+            finite = jnp.asarray(all_finite(list(grads.values())))
+            if not guard_select:
+                return new_params, new_aux, new_opt, outs, finite
+            sel = lambda new, old: jax.tree_util.tree_map(
+                lambda a, b: jnp.where(finite, a, b), new, old)
+            # a bad step must leave params, batchnorm-style aux
+            # updates, AND optimizer state untouched — on device,
+            # every step, regardless of host read cadence
+            return (sel(new_params, params), sel(new_aux, dict(aux)),
+                    sel(new_opt, opt_state), outs, finite)
 
         rep = replicated(self.mesh)
         p_sh = {n: rep for n in self.params}
@@ -109,8 +142,8 @@ class SymbolTrainStep:
         in_sh = {n: self._in_shard(v.ndim) for n, v in inputs.items()}
         return jax.jit(
             step,
-            in_shardings=(p_sh, a_sh, None, in_sh, None, None),
-            out_shardings=(p_sh, a_sh, None, None),
+            in_shardings=(p_sh, a_sh, None, in_sh, None, None, None),
+            out_shardings=(p_sh, a_sh, None, None, None),
             donate_argnums=(0, 1, 2))
 
     # ------------------------------------------------------------ run
@@ -129,9 +162,15 @@ class SymbolTrainStep:
             self._step = self._build(vals)
         vals = {n: jax.device_put(v, self._in_shard(v.ndim))
                 for n, v in vals.items()}
-        self.params, self.aux, self.opt_state, outs = self._step(
+        poison = 1.0
+        if self._guarded:
+            from ..optimizer import grad_poison
+            poison = grad_poison() or 1.0
+        (self.params, self.aux, self.opt_state, outs,
+         self.last_finite) = self._step(
             self.params, self.aux, self.opt_state, vals, rng,
-            jnp.asarray(lr, jnp.float32))
+            jnp.asarray(lr, jnp.float32),
+            jnp.asarray(poison, jnp.float32))
         return outs
 
     def evaluate(self, inputs, rng=None):

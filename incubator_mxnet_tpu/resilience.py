@@ -29,6 +29,7 @@ is the one place those defenses live:
 Everything here is stdlib-only and import-light so dist workers can
 use it before jax is up.
 """
+import math
 import os
 import random
 import tempfile
@@ -41,7 +42,9 @@ from .utils.env import get_env
 
 __all__ = ["ResilienceError", "TransientError", "DeadlineExceededError",
            "CollectiveAbortedError", "DataPipelineError",
-           "CheckpointCorruptError", "RetryPolicy", "retry_call",
+           "CheckpointCorruptError", "BadStepError", "DivergedError",
+           "NumericGuard", "install_diverged_exithook",
+           "RetryPolicy", "retry_call",
            "deadline_call", "call_transient_mapped", "TRANSIENT_MARKERS",
            "JOIN_TRANSIENT_MARKERS", "decode_or_corrupt",
            "parse_fault_spec", "faults_active",
@@ -82,6 +85,28 @@ class CheckpointCorruptError(ResilienceError, IOError):
 
     Subclasses IOError so legacy ``except IOError`` checkpoint
     handling still catches it."""
+
+
+class BadStepError(ResilienceError, ArithmeticError):
+    """A single training step produced non-finite gradients (or a
+    loss spike) under ``MXTPU_NONFINITE_POLICY=raise``.
+
+    Also an ArithmeticError so generic numeric guards in user loops
+    (``except ArithmeticError``) keep working."""
+
+
+class DivergedError(ResilienceError, ArithmeticError):
+    """Training diverged: MXTPU_MAX_BAD_STEPS *consecutive* steps
+    were non-finite, so skipping updates can no longer save the run
+    (the parameters or data are bad, not one unlucky batch).
+
+    The fit loops roll back to the newest valid checkpoint before
+    re-raising this, and training mains should exit with
+    :data:`EXIT_CODE` (see :func:`install_diverged_exithook`) so the
+    launcher restart loop can tell divergence — restart resumes from
+    the rolled-back checkpoint — from an ordinary crash."""
+
+    EXIT_CODE = 13
 
 
 class DataPipelineError(ResilienceError):
@@ -300,7 +325,13 @@ _FAULT_LOCK = threading.Lock()
 _FAULT_CACHE = (None, ())          # (raw env string, parsed specs)
 _FAULT_COUNTS = {}                 # (scope, op) -> calls seen
 
-_FAULT_KINDS = ("hang", "error", "truncate", "corrupt")
+_FAULT_KINDS = ("hang", "error", "truncate", "corrupt",
+                "nan", "inf", "spike")
+
+# numeric poison kinds: only meaningful where step numerics flow —
+# gradients (scope 'grad', applied by the guarded updaters) and loss
+# values (scope 'loss', applied by NumericGuard.check_loss)
+_NUMERIC_KINDS = ("nan", "inf", "spike")
 
 
 def parse_fault_spec(raw):
@@ -335,6 +366,14 @@ def parse_fault_spec(raw):
             raise ValueError(
                 f"bad fault spec {entry!r}: kind {kind!r} only "
                 "applies to the 'checkpoint' and 'record' scopes")
+        if kind in ("nan", "inf") and scope not in ("grad", "loss"):
+            raise ValueError(
+                f"bad fault spec {entry!r}: kind {kind!r} only "
+                "applies to the 'grad' and 'loss' scopes")
+        if kind == "spike" and scope != "loss":
+            raise ValueError(
+                f"bad fault spec {entry!r}: kind 'spike' only "
+                "applies to the 'loss' scope")
         if nth != "*":
             try:
                 nth = int(nth)
@@ -392,7 +431,10 @@ def inject(scope, op):
     MXTPU_FAULT_HANG_S (run this *inside* a deadline-wrapped callable
     so the deadline, not the sleep, decides the outcome);
     ``truncate``/``corrupt`` are returned for data-path callers
-    (atomic_save) to apply."""
+    (atomic_save) to apply, as are the numeric kinds
+    ``nan``/``inf``/``spike`` for the step-sentinel callers
+    (guarded updaters poison a gradient, check_loss poisons the
+    loss — docs/numeric_stability.md)."""
     kind = fault_for(scope, op)
     if kind == "error":
         raise TransientError(
@@ -401,6 +443,199 @@ def inject(scope, op):
         time.sleep(get_env("MXTPU_FAULT_HANG_S"))
         return None
     return kind
+
+
+# ---------------------------------------------------------------------------
+# training-step sentinel
+# ---------------------------------------------------------------------------
+
+
+class NumericGuard:
+    """Policy + accounting for the training-step sentinel
+    (docs/numeric_stability.md).
+
+    The guarded update paths (optimizer.GuardedUpdater,
+    gluon.Trainer.step, Module's mesh step) reduce the whole step's
+    gradients to ONE on-device finiteness scalar; this class decides
+    what the host does with it.  ``MXTPU_NONFINITE_POLICY``:
+
+    - ``off``   — sentinel disabled (default; zero overhead).
+    - ``warn``  — warn on a bad step but apply the update anyway.
+    - ``skip``  — skip the update (weights, optimizer state, and the
+      LR-scheduler/step-count advance all stay untouched).
+    - ``raise`` — raise :class:`BadStepError` on the first bad step.
+
+    The device->host read happens every ``MXTPU_GUARD_INTERVAL``
+    guarded steps (``checks`` counts them — the guard's entire sync
+    cost).  ``MXTPU_MAX_BAD_STEPS`` *consecutive* bad verdicts raise
+    :class:`DivergedError` regardless of policy: by then skipping is
+    not helping, and the fit loops answer with a checkpoint rollback.
+
+    Host-side loss watching (:meth:`check_loss`) additionally flags
+    non-finite losses and, with ``MXTPU_LOSS_SPIKE_FACTOR`` > 0,
+    losses that jump that factor above their running mean.
+    Injection scopes ``grad:nonfinite`` (applied by the guarded
+    updaters) and ``loss:spike`` (applied here) make every policy
+    CPU-testable via ``MXTPU_FAULT_SPEC``."""
+
+    POLICIES = ("off", "warn", "skip", "raise")
+
+    def __init__(self, policy=None, interval=None, max_bad_steps=None,
+                 spike_factor=None, name="train"):
+        self.policy = (policy if policy is not None
+                       else get_env("MXTPU_NONFINITE_POLICY")).lower()
+        if self.policy not in self.POLICIES:
+            raise ValueError(
+                f"bad MXTPU_NONFINITE_POLICY {self.policy!r}: want "
+                f"one of {self.POLICIES}")
+        self.interval = max(1, int(
+            interval if interval is not None
+            else get_env("MXTPU_GUARD_INTERVAL")))
+        self.max_bad_steps = int(
+            max_bad_steps if max_bad_steps is not None
+            else get_env("MXTPU_MAX_BAD_STEPS"))
+        self.spike_factor = float(
+            spike_factor if spike_factor is not None
+            else get_env("MXTPU_LOSS_SPIKE_FACTOR"))
+        self.name = name
+        self.steps = 0              # guarded steps begun
+        self.checks = 0             # host reads consumed (sync cost)
+        self.bad_steps = 0          # bad verdicts seen (total)
+        self.consecutive_bad = 0
+        self.skipped_steps = 0
+        self._loss_ema = None
+        self._warned_skip = False
+
+    @property
+    def enabled(self):
+        return self.policy != "off"
+
+    @property
+    def drops_updates(self):
+        """Whether a bad step's update must not reach the weights:
+        ``skip`` drops it silently, ``raise`` aborts the step — in
+        both cases the fused paths route the update through the
+        on-device select.  ``warn`` applies the update anyway (its
+        documented contract), so the select must NOT engage."""
+        return self.policy in ("skip", "raise")
+
+    def begin_step(self):
+        """Advance the guarded-step counter; True when this step is
+        due a host-side check of the finiteness scalar (every
+        ``interval``-th guarded step).  Steps in between must not
+        read the flag — that is the whole point of the interval."""
+        due = self.enabled and (self.steps % self.interval == 0)
+        self.steps += 1
+        return due
+
+    def record(self, finite, what="gradients", dropped=1):
+        """Consume one host-read verdict -> ``"ok"`` | ``"skip"``.
+
+        Applies the policy, tracks consecutive bad steps, and raises
+        :class:`DivergedError` once ``max_bad_steps`` consecutive
+        verdicts were bad (0 disables divergence detection).
+        ``dropped`` is how many updates this bad verdict stands for —
+        with MXTPU_GUARD_INTERVAL > 1 one host read covers a whole
+        window of device-checked steps, and the fused paths report
+        the window's exact on-device bad count so ``skipped_steps``
+        stays truthful."""
+        self.checks += 1
+        if finite:
+            self.consecutive_bad = 0
+            return "ok"
+        self.bad_steps += 1
+        self.consecutive_bad += 1
+        msg = (f"non-finite {what} in guarded step {self.steps} "
+               f"({self.name}; consecutive bad: "
+               f"{self.consecutive_bad})")
+        if self.max_bad_steps > 0 and \
+                self.consecutive_bad >= self.max_bad_steps:
+            raise DivergedError(
+                f"{msg}: {self.max_bad_steps} consecutive bad steps "
+                "— training diverged; roll back to the newest valid "
+                "checkpoint (docs/numeric_stability.md)")
+        if self.policy == "raise":
+            raise BadStepError(msg)
+        if self.policy == "warn":
+            warnings.warn(msg + "; applying the update anyway "
+                          "(MXTPU_NONFINITE_POLICY=warn)",
+                          RuntimeWarning)
+            return "ok"
+        self.skipped_steps += max(int(dropped), 1)
+        if not self._warned_skip:
+            warnings.warn(
+                msg + "; skipping the update (weights, optimizer "
+                "state, and LR schedule untouched; warned once)",
+                RuntimeWarning)
+            self._warned_skip = True
+        return "skip"
+
+    def check_loss(self, value, what="loss"):
+        """Judge a host-side loss scalar -> ``"ok"`` | ``"skip"``.
+
+        Injection point ``loss:spike`` (kinds nan/inf/spike).  A
+        non-finite loss is always bad; with ``spike_factor`` > 0 a
+        finite loss larger than ``spike_factor`` x the running mean
+        of previous good losses is bad too (the footprint of a
+        just-poisoned optimizer state *before* everything turns NaN).
+        Costs nothing on device — callers already have the scalar."""
+        if not self.enabled:
+            return "ok"
+        kind = inject("loss", "spike") if faults_active() else None
+        v = float(value)
+        injected_spike = kind == "spike"
+        if kind == "nan":
+            v = float("nan")
+        elif kind == "inf":
+            v = float("inf")
+        elif injected_spike:
+            base = abs(self._loss_ema) if self._loss_ema else 1.0
+            v = base * max(self.spike_factor, 2.0) * 10.0
+        finite = math.isfinite(v)
+        # an injected spike is bad by definition — the injection must
+        # exercise the bad-step path even with the detector's
+        # spike_factor threshold left at its disabled default
+        spiked = injected_spike or (
+            finite and self.spike_factor > 0
+            and self._loss_ema is not None
+            and abs(v) > self.spike_factor
+            * max(abs(self._loss_ema), 1e-12))
+        verdict = self.record(finite and not spiked, what=what)
+        if finite and not spiked:
+            self._loss_ema = v if self._loss_ema is None \
+                else 0.9 * self._loss_ema + 0.1 * v
+        return verdict
+
+
+_DIVERGED_HOOK = {"installed": False}
+
+
+def install_diverged_exithook():
+    """Make an uncaught :class:`DivergedError` terminate the process
+    with ``DivergedError.EXIT_CODE`` instead of the generic 1, so
+    the launcher restart loop (tools/launch.py) can tell divergence
+    — resume from the rolled-back checkpoint — from a crash.
+
+    Idempotent; chains to the previous excepthook for everything
+    else.  dist.init() installs it automatically for launcher-spawned
+    workers; single-process mains may call it themselves."""
+    import sys
+    if _DIVERGED_HOOK["installed"]:
+        return
+    _DIVERGED_HOOK["installed"] = True
+    prev = sys.excepthook
+
+    def hook(tp, val, tb):
+        prev(tp, val, tb)
+        if isinstance(val, DivergedError):
+            sys.stdout.flush()
+            sys.stderr.flush()
+            # excepthooks cannot set the interpreter's exit status;
+            # traceback is already printed, buffers flushed —
+            # hard-exit with the distinct code
+            os._exit(DivergedError.EXIT_CODE)
+
+    sys.excepthook = hook
 
 
 # ---------------------------------------------------------------------------

@@ -105,6 +105,44 @@ register_env("MXTPU_CKPT_FALLBACK", bool, True,
              "on corrupt/truncated checkpoint load, fall back to the "
              "newest earlier checkpoint that validates")
 
+# Training-step sentinel (resilience.NumericGuard, optimizer,
+# gluon/trainer, module fit loops; docs/numeric_stability.md).
+register_env("MXTPU_NONFINITE_POLICY", str, "off",
+             "non-finite gradient/loss policy for guarded training "
+             "steps: off (default) | warn | skip (drop the update, "
+             "keep weights/optimizer/LR state untouched) | raise "
+             "(BadStepError on the first bad step)")
+register_env("MXTPU_GUARD_INTERVAL", int, 1,
+             "guarded steps between device->host reads of the fused "
+             "finiteness scalar; the sentinel's entire sync cost is "
+             "one scalar read per interval")
+register_env("MXTPU_MAX_BAD_STEPS", int, 10,
+             "consecutive bad steps before DivergedError (fit loops "
+             "roll back to the newest valid checkpoint and re-raise "
+             "for the launcher restart loop); 0 disables")
+register_env("MXTPU_LOSS_SPIKE_FACTOR", float, 0.0,
+             "NumericGuard.check_loss flags a finite loss larger "
+             "than this factor x its running mean as a bad step; "
+             "0 (default) checks only finiteness")
+register_env("MXTPU_LOSS_SCALE", float, 1.0,
+             "initial loss scale for optimizer.LossScaler (gluon "
+             "Trainer mixed-precision loops multiply the loss by "
+             "Trainer.loss_scale; step() rescales gradients back)")
+register_env("MXTPU_LOSS_SCALE_DYNAMIC", bool, False,
+             "grow/backoff the loss scale dynamically on "
+             "overflow signals from the step sentinel")
+register_env("MXTPU_LOSS_SCALE_GROWTH", float, 2.0,
+             "dynamic loss-scale growth factor after "
+             "MXTPU_LOSS_SCALE_WINDOW consecutive good steps")
+register_env("MXTPU_LOSS_SCALE_BACKOFF", float, 0.5,
+             "dynamic loss-scale backoff factor on an overflow "
+             "(non-finite) step")
+register_env("MXTPU_LOSS_SCALE_WINDOW", int, 2000,
+             "consecutive good steps before the dynamic loss scale "
+             "grows")
+register_env("MXTPU_LOSS_SCALE_MAX", float, float(2 ** 24),
+             "upper bound for the dynamic loss scale")
+
 # Data-pipeline resilience (io/, gluon/data/; docs/data_pipeline.md).
 register_env("MXTPU_DATA_TIMEOUT", float, 600.0,
              "wall-clock deadline (s) on input-pipeline queue waits; "

@@ -1,0 +1,66 @@
+"""Worker script for the multi-rank step-sentinel test — run under
+tools/launch.py (see tests/test_sentinel.py).
+
+Rank 0 injects grad:nonfinite on two of six guarded steps; rank 1
+injects nothing.  The allreduced finiteness flag must make BOTH
+ranks skip exactly the same steps — a rank-local skip would
+desynchronize optimizer state (docs/numeric_stability.md).
+
+Not a pytest module: tests/test_sentinel.py spawns it.
+"""
+import os
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import dist
+from incubator_mxnet_tpu import optimizer as opt_mod
+from incubator_mxnet_tpu import resilience as rz
+
+
+def main():
+    r = dist.init()
+    assert dist.num_workers() == 2, dist.num_workers()
+    if r == 0:
+        os.environ["MXTPU_FAULT_SPEC"] = \
+            "grad:nonfinite:2:nan,grad:nonfinite:5:inf"
+        rz.reset_faults()
+
+    opt = opt_mod.create("sgd", learning_rate=0.1)
+    guard = rz.NumericGuard(policy="skip", interval=1,
+                            max_bad_steps=0)
+    up = opt_mod.GuardedUpdater(opt, guard=guard)
+    w = mx.nd.array(np.ones((4,), np.float32))
+    decisions = []
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for _ in range(6):
+            g = mx.nd.array(np.full((4,), 0.25, np.float32))
+            proceed = up.begin_step([g])
+            decisions.append(1.0 if proceed else 0.0)
+            up(0, g, w)
+
+    # every rank must have skipped steps 2 and 5 (rank 0's faults)
+    assert decisions == [1, 0, 1, 1, 0, 1], (r, decisions)
+    # cross-check agreement collectively: if the vectors were equal
+    # on all ranks, the sum is exactly 2x the local vector
+    local = jax.numpy.asarray(decisions, jax.numpy.float32)
+    summed = np.asarray(dist.allreduce_sum(local))
+    assert np.allclose(summed, 2 * np.asarray(decisions)), \
+        (r, decisions, summed)
+    # weights identical across ranks (same updates applied)
+    wsum = np.asarray(dist.allreduce_sum(
+        jax.numpy.asarray(w.asnumpy())))
+    assert np.allclose(wsum, 2 * w.asnumpy()), (r, wsum)
+    assert np.all(np.isfinite(w.asnumpy()))
+    assert guard.skipped_steps == 2
+
+    print(f"SENTINEL_OK rank {r}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,647 @@
+"""Training-step sentinel (docs/numeric_stability.md): NumericGuard
+policies, dynamic loss scaling, guarded Trainer/Module update paths
+(eager, fused, and kvstore='tpu' mesh), divergence rollback to the
+newest valid checkpoint, and the one-scalar-per-guard-interval
+transfer budget — all CPU-tested via the grad:nonfinite / loss:spike
+fault-injection scopes."""
+import math
+import os
+import subprocess
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+from incubator_mxnet_tpu import optimizer as opt_mod
+from incubator_mxnet_tpu import resilience as rz
+from incubator_mxnet_tpu.gluon import nn
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_sentinel_env(monkeypatch):
+    for var in ("MXTPU_FAULT_SPEC", "MXTPU_NONFINITE_POLICY",
+                "MXTPU_GUARD_INTERVAL", "MXTPU_MAX_BAD_STEPS",
+                "MXTPU_LOSS_SCALE", "MXTPU_LOSS_SCALE_DYNAMIC",
+                "MXTPU_LOSS_SCALE_WINDOW", "MXTPU_LOSS_SPIKE_FACTOR"):
+        monkeypatch.delenv(var, raising=False)
+    rz.reset_faults()
+    yield
+    rz.reset_faults()
+
+
+# ---------------------------------------------------------------- guard
+def test_guard_policies_and_divergence():
+    g = rz.NumericGuard(policy="skip", interval=1, max_bad_steps=3)
+    assert g.enabled
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        assert g.record(True) == "ok"
+        assert g.record(False) == "skip"
+        assert g.record(True) == "ok"          # resets consecutive
+        assert g.consecutive_bad == 0
+        assert g.record(False) == "skip"
+        assert g.record(False) == "skip"
+        with pytest.raises(rz.DivergedError):
+            g.record(False)
+    assert g.bad_steps == 4 and g.skipped_steps == 3
+
+    with pytest.raises(rz.BadStepError):
+        rz.NumericGuard(policy="raise", max_bad_steps=0).record(False)
+
+    g = rz.NumericGuard(policy="warn", max_bad_steps=0)
+    with pytest.warns(RuntimeWarning):
+        assert g.record(False) == "ok"         # warn applies anyway
+
+    assert not rz.NumericGuard(policy="off").enabled
+    with pytest.raises(ValueError):
+        rz.NumericGuard(policy="bogus")
+
+
+def test_guard_interval_due_cadence():
+    g = rz.NumericGuard(policy="skip", interval=3, max_bad_steps=0)
+    due = [g.begin_step() for _ in range(7)]
+    assert due == [True, False, False, True, False, False, True]
+    # disabled guard is never due
+    g2 = rz.NumericGuard(policy="off", interval=1)
+    assert [g2.begin_step() for _ in range(3)] == [False] * 3
+
+
+def test_fault_spec_numeric_kinds():
+    assert rz.parse_fault_spec("grad:nonfinite:3:nan,loss:spike:1:inf")
+    assert rz.parse_fault_spec("loss:spike:2:spike")
+    with pytest.raises(ValueError):        # numeric kind, wrong scope
+        rz.parse_fault_spec("checkpoint:save:1:nan")
+    with pytest.raises(ValueError):        # spike is loss-only
+        rz.parse_fault_spec("grad:nonfinite:1:spike")
+
+
+def test_check_loss_finiteness_spike_and_injection(monkeypatch):
+    g = rz.NumericGuard(policy="skip", interval=1, max_bad_steps=0,
+                        spike_factor=10.0)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        assert g.check_loss(1.0) == "ok"
+        assert g.check_loss(float("nan")) == "skip"
+        assert g.check_loss(1.1) == "ok"
+        assert g.check_loss(500.0) == "skip"   # > 10x running mean
+        assert g.check_loss(1.2) == "ok"
+    # injection: the 2nd check_loss call sees a spiking loss
+    monkeypatch.setenv("MXTPU_FAULT_SPEC", "loss:spike:2:spike")
+    rz.reset_faults()
+    g = rz.NumericGuard(policy="skip", interval=1, max_bad_steps=0,
+                        spike_factor=10.0)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        assert g.check_loss(1.0) == "ok"
+        assert g.check_loss(1.0) == "skip"
+        assert g.check_loss(1.0) == "ok"
+    assert g.bad_steps == 1
+    # policy off: check_loss costs nothing and never flags
+    assert rz.NumericGuard(policy="off").check_loss(
+        float("nan")) == "ok"
+    # an injected spike flags even with the detector threshold left
+    # at its disabled default (and must not corrupt the loss EMA)
+    monkeypatch.setenv("MXTPU_FAULT_SPEC", "loss:spike:2:spike")
+    rz.reset_faults()
+    g = rz.NumericGuard(policy="skip", interval=1, max_bad_steps=0,
+                        spike_factor=0.0)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        assert g.check_loss(1.0) == "ok"
+        assert g.check_loss(1.0) == "skip"
+    assert g._loss_ema == 1.0
+
+
+# ---------------------------------------------------------------- scaler
+def test_loss_scaler_backoff_and_growth():
+    s = opt_mod.LossScaler(init_scale=8.0, dynamic=True, growth=2.0,
+                           backoff=0.5, window=2, max_scale=16.0)
+    assert s.active
+    assert s.update(overflow=True) == 4.0
+    assert s.num_backoffs == 1
+    s.update(False)
+    assert s.scale == 4.0                   # window not reached yet
+    s.update(False)
+    assert s.scale == 8.0 and s.num_growths == 1
+    for _ in range(4):
+        s.update(False)
+    assert s.scale == 16.0                  # capped at max
+    # floor: backoff never goes below 1
+    s2 = opt_mod.LossScaler(init_scale=1.0, dynamic=True, backoff=0.5,
+                            window=100, max_scale=16.0)
+    assert s2.update(overflow=True) == 1.0
+    # defaults are inert
+    s3 = opt_mod.LossScaler()
+    assert not s3.active and s3.update(True) == 1.0
+
+
+def test_loss_scaler_state_roundtrip():
+    s = opt_mod.LossScaler(init_scale=4.0, dynamic=True, window=5)
+    s.update(False)
+    state = s.state_dict()
+    s2 = opt_mod.LossScaler(init_scale=1.0, dynamic=True, window=5)
+    s2.load_state_dict(state)
+    assert s2.scale == 4.0 and s2._good_steps == 1
+
+
+# ---------------------------------------------------------------- flag
+def test_all_finite_and_window_accumulation():
+    ok = mx.nd.array(np.ones((3, 2), np.float32))
+    bad = mx.nd.array(np.array([1.0, np.inf], np.float32))
+    ints = mx.nd.array(np.arange(4), dtype="int32")
+    assert bool(opt_mod.all_finite([ok])) is True
+    assert bool(opt_mod.all_finite([ok, bad])) is False
+    # integer leaves are skipped; empty list is trivially finite
+    assert opt_mod.all_finite([ints]) is True
+    assert opt_mod.all_finite([]) is True
+    assert bool(opt_mod.all_finite([ints, ok])) is True
+    # the window counter accumulates per-step flags on device and
+    # resets on read
+    g = rz.NumericGuard(policy="skip", interval=4, max_bad_steps=0)
+    for arrays in ([ok], [ok, bad], [bad], [ok]):
+        opt_mod.accumulate_window(g, opt_mod.all_finite(arrays))
+    assert opt_mod.read_window_bad(g) == 2
+    assert opt_mod.read_window_bad(g) == 0      # reset after read
+
+
+# ---------------------------------------------------------------- updater
+def test_guarded_updater_skips_step_and_step_count(monkeypatch):
+    monkeypatch.setenv("MXTPU_FAULT_SPEC", "grad:nonfinite:2:nan")
+    rz.reset_faults()
+    opt = opt_mod.create("sgd", learning_rate=0.1)
+    up = opt_mod.GuardedUpdater(
+        opt, guard=rz.NumericGuard(policy="skip", interval=1,
+                                   max_bad_steps=0))
+    w = mx.nd.array(np.ones((4,), np.float32))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        g1 = mx.nd.array(np.full((4,), 0.5, np.float32))
+        assert up.begin_step([g1])
+        up(0, g1, w)
+        after_good = w.asnumpy().copy()
+        g2 = mx.nd.array(np.full((4,), 0.5, np.float32))
+        assert not up.begin_step([g2])      # poisoned -> skip
+        up(0, g2, w)                        # no-op
+    assert np.allclose(w.asnumpy(), after_good)
+    assert np.all(np.isfinite(w.asnumpy()))
+    # skipped step advanced neither num_update nor the per-key count
+    assert opt.num_update == 1
+    assert up.guard.skipped_steps == 1
+
+
+def test_guarded_updater_raise_policy(monkeypatch):
+    monkeypatch.setenv("MXTPU_FAULT_SPEC", "grad:nonfinite:1:inf")
+    rz.reset_faults()
+    up = opt_mod.GuardedUpdater(
+        opt_mod.create("sgd"),
+        guard=rz.NumericGuard(policy="raise", interval=1,
+                              max_bad_steps=0))
+    g = mx.nd.array(np.ones((2,), np.float32))
+    with pytest.raises(rz.BadStepError):
+        up.begin_step([g])
+
+
+def test_transfer_budget_one_read_per_interval(monkeypatch):
+    """The guard's entire sync cost: one scalar device->host read
+    per MXTPU_GUARD_INTERVAL steps, counted both by the guard and by
+    intercepting read_window_bad (the sole transfer point) itself."""
+    reads = []
+    orig = opt_mod.read_window_bad
+    monkeypatch.setattr(opt_mod, "read_window_bad",
+                        lambda g: reads.append(1) or orig(g))
+    opt = opt_mod.create("sgd", learning_rate=0.1)
+    guard = rz.NumericGuard(policy="skip", interval=4,
+                            max_bad_steps=0)
+    up = opt_mod.GuardedUpdater(opt, guard=guard)
+    w = mx.nd.array(np.ones((4,), np.float32))
+    for _ in range(8):
+        g = mx.nd.array(np.full((4,), 0.1, np.float32))
+        assert up.begin_step([g])
+        up(0, g, w)
+    assert len(reads) == 2                  # steps 0 and 4
+    assert guard.checks == 2
+    assert guard.steps == 8
+
+
+# ---------------------------------------------------------------- gluon
+def _toy_data(n=100, dim=10, classes=3, seed=0):
+    rs = np.random.RandomState(seed)
+    return (rs.randn(n, dim).astype("float32"),
+            rs.randint(0, classes, n).astype("float32"))
+
+
+def _train_gluon(optimizer, steps=6, batch=10):
+    mx.random.seed(42)
+    data, labels = _toy_data()
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"))
+    net.add(nn.Dense(3))
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), optimizer,
+                            {"learning_rate": 0.1})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for step in range(steps):
+            lo = (step * batch) % len(data)
+            x = nd.array(data[lo:lo + batch])
+            y = nd.array(labels[lo:lo + batch])
+            with autograd.record():
+                loss = loss_fn(net(x), y) * trainer.loss_scale
+            loss.backward()
+            trainer.step(batch)
+    return net, trainer
+
+
+def test_trainer_fused_path_skips_injected_bad_steps(monkeypatch):
+    monkeypatch.setenv("MXTPU_NONFINITE_POLICY", "skip")
+    monkeypatch.setenv("MXTPU_FAULT_SPEC", "grad:nonfinite:3:nan")
+    rz.reset_faults()
+    net, trainer = _train_gluon("sgd", steps=6)
+    assert trainer._fused_active()
+    for p in net.collect_params().values():
+        assert np.all(np.isfinite(p.data().asnumpy()))
+    assert trainer.guard.skipped_steps == 1
+    assert trainer.guard.steps == 6
+    # skipped step did not advance the LR-schedule step count
+    assert trainer._optimizer.num_update == 5
+
+
+def test_trainer_eager_path_skips_injected_bad_steps(monkeypatch):
+    monkeypatch.setenv("MXTPU_NONFINITE_POLICY", "skip")
+    monkeypatch.setenv("MXTPU_FAULT_SPEC", "grad:nonfinite:2:inf")
+    rz.reset_faults()
+    # adagrad has no functional counterpart -> eager updater loop
+    net, trainer = _train_gluon("adagrad", steps=5)
+    assert not trainer._fused_active()
+    for p in net.collect_params().values():
+        assert np.all(np.isfinite(p.data().asnumpy()))
+    assert trainer.guard.skipped_steps == 1
+    assert trainer._optimizer.num_update == 4
+
+
+@pytest.mark.parametrize("optimizer", ["adagrad", "sgd"])
+def test_trainer_warn_policy_applies_bad_updates(optimizer,
+                                                 monkeypatch):
+    """The injection poisons REAL gradients, and warn's contract is
+    to apply the update anyway: on both the eager (adagrad) and
+    fused (sgd) paths the parameters end up NaN — proof the
+    skip-policy runs above are protecting, not just counting, and
+    that the fused where-select stays off under warn."""
+    monkeypatch.setenv("MXTPU_NONFINITE_POLICY", "warn")
+    monkeypatch.setenv("MXTPU_FAULT_SPEC", "grad:nonfinite:2:nan")
+    rz.reset_faults()
+    net, trainer = _train_gluon(optimizer, steps=4)
+    assert trainer.guard.bad_steps >= 1
+    assert trainer.guard.skipped_steps == 0
+    flat = np.concatenate([p.data().asnumpy().ravel()
+                           for p in net.collect_params().values()])
+    assert not np.all(np.isfinite(flat))
+
+
+def test_trainer_interval_observes_offread_bad_steps(monkeypatch):
+    """MXTPU_GUARD_INTERVAL > 1 on the fused path: a bad step landing
+    BETWEEN host reads is dropped on device by the in-jit select and
+    still observed at the next read via the on-device bad counter —
+    exact skipped count and num_update compensation, one read per
+    window."""
+    monkeypatch.setenv("MXTPU_NONFINITE_POLICY", "skip")
+    monkeypatch.setenv("MXTPU_GUARD_INTERVAL", "4")
+    # steps 2 and 3 go bad; the window read happens at step 4
+    monkeypatch.setenv("MXTPU_FAULT_SPEC",
+                       "grad:nonfinite:2:nan,grad:nonfinite:3:inf")
+    rz.reset_faults()
+    net, trainer = _train_gluon("sgd", steps=8)
+    for p in net.collect_params().values():
+        assert np.all(np.isfinite(p.data().asnumpy()))
+    assert trainer.guard.checks == 2            # steps 4 and 8 read
+    assert trainer.guard.skipped_steps == 2     # both observed
+    assert trainer.guard.bad_steps == 1         # one bad *window*
+    # 8 scheduled_lr advances minus the 2 device-dropped updates
+    assert trainer._optimizer.num_update == 6
+
+
+def test_trainer_dynamic_loss_scale_backoff_and_regrowth(monkeypatch):
+    monkeypatch.setenv("MXTPU_LOSS_SCALE", "8")
+    monkeypatch.setenv("MXTPU_LOSS_SCALE_DYNAMIC", "1")
+    monkeypatch.setenv("MXTPU_LOSS_SCALE_WINDOW", "2")
+    monkeypatch.setenv("MXTPU_FAULT_SPEC", "grad:nonfinite:2:nan")
+    rz.reset_faults()
+    net, trainer = _train_gluon("sgd", steps=7)
+    # dynamic scaling promoted the guard to skip-on-overflow
+    assert trainer.guard.policy == "skip"
+    scaler = trainer._scaler
+    assert scaler.num_backoffs == 1         # step 2 overflowed: 8->4
+    assert scaler.num_growths == 2          # 4 -> 8 -> 16 over two
+    assert scaler.scale == 16.0             # windows of good steps
+    for p in net.collect_params().values():
+        assert np.all(np.isfinite(p.data().asnumpy()))
+
+
+def test_trainer_static_loss_scale_matches_unscaled(monkeypatch):
+    """A static loss scale must be arithmetically invisible: loss x8
+    at record time, grads /8 in step()."""
+    net_a, _ = _train_gluon("sgd", steps=3)
+    monkeypatch.setenv("MXTPU_LOSS_SCALE", "8")
+    net_b, trainer = _train_gluon("sgd", steps=3)
+    assert trainer.loss_scale == 8.0
+    for pa, pb in zip(net_a.collect_params().values(),
+                      net_b.collect_params().values()):
+        np.testing.assert_allclose(pa.data().asnumpy(),
+                                   pb.data().asnumpy(),
+                                   rtol=2e-5, atol=2e-6)
+
+
+# ---------------------------------------------------------------- module
+def _toy_module_problem(n=64, dim=10, classes=5, batch=16, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.rand(n, dim).astype(np.float32)
+    w = rs.rand(dim, classes).astype(np.float32)
+    y = (x @ w).argmax(1).astype(np.float32)
+    it = mx.io.NDArrayIter(x, y, batch_size=batch,
+                           label_name="softmax_label")
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc", num_hidden=classes)
+    return it, mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+@pytest.mark.parametrize("kvstore", ["local", "tpu"])
+def test_module_fit_skips_injected_bad_steps(kvstore, monkeypatch):
+    monkeypatch.setenv("MXTPU_NONFINITE_POLICY", "skip")
+    monkeypatch.setenv("MXTPU_FAULT_SPEC", "grad:nonfinite:2:nan")
+    rz.reset_faults()
+    it, sym = _toy_module_problem()
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        mod.fit(it, num_epoch=2, kvstore=kvstore, optimizer="sgd",
+                optimizer_params=dict(learning_rate=0.5),
+                initializer=mx.initializer.Xavier())
+    arg, _ = mod.get_params()
+    for k, v in arg.items():
+        assert np.all(np.isfinite(v.asnumpy())), k
+    assert mod._guard.skipped_steps == 1
+    assert mod._guard.steps == 8            # 2 epochs x 4 batches
+
+
+def test_module_fit_guard_interval_transfer_budget(monkeypatch):
+    monkeypatch.setenv("MXTPU_NONFINITE_POLICY", "skip")
+    monkeypatch.setenv("MXTPU_GUARD_INTERVAL", "4")
+    it, sym = _toy_module_problem()
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        mod.fit(it, num_epoch=2, optimizer="sgd",
+                initializer=mx.initializer.Xavier())
+    # 8 update steps, interval 4 -> exactly 2 host reads
+    assert mod._guard.steps == 8
+    assert mod._guard.checks == 2
+
+
+@pytest.mark.parametrize("kvstore", ["local", "tpu"])
+def test_module_fit_rollback_on_divergence(kvstore, monkeypatch,
+                                           tmp_path):
+    """MXTPU_MAX_BAD_STEPS consecutive bad steps: fit restores the
+    newest valid checkpoint — params, optimizer .states, and the
+    .data iterator companion — then re-raises DivergedError.  On
+    the kvstore='tpu' path the restored values must also displace
+    the mesh step's device copies (not be clobbered by a pending
+    mesh sync)."""
+    monkeypatch.setenv("MXTPU_NONFINITE_POLICY", "skip")
+    monkeypatch.setenv("MXTPU_MAX_BAD_STEPS", "3")
+    it, sym = _toy_module_problem()
+    prefix = str(tmp_path / "ckpt")
+
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        mod.fit(it, num_epoch=1, kvstore=kvstore, optimizer="sgd",
+                optimizer_params=dict(learning_rate=0.5),
+                initializer=mx.initializer.Xavier())
+        it.reset()
+        next(it)
+        next(it)    # checkpoint mid-epoch: iter positioned at batch 2
+        mod.save_checkpoint(prefix, 0, save_optimizer_states=True,
+                            data_iter=it)
+    saved_cursor = it.state_dict()["cursor"]
+    saved = {k: v.asnumpy().copy()
+             for k, v in mod.get_params()[0].items()}
+
+    monkeypatch.setenv("MXTPU_FAULT_SPEC", "grad:nonfinite:*:nan")
+    rz.reset_faults()
+    it2, _ = _toy_module_problem()
+    mod2 = mx.mod.Module(sym, context=mx.cpu())
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with pytest.raises(rz.DivergedError):
+            mod2.fit(it2, num_epoch=2, kvstore=kvstore,
+                     optimizer="sgd",
+                     optimizer_params=dict(learning_rate=0.5),
+                     initializer=mx.initializer.Xavier(),
+                     checkpoint_prefix=prefix)
+    arg, _ = mod2.get_params()
+    for k, v in arg.items():
+        np.testing.assert_allclose(v.asnumpy(), saved[k],
+                                   err_msg=k)
+    # optimizer state restored alongside (momentum-free sgd states
+    # exist as an empty-but-valid pickle; the load must not degrade)
+    assert mod2._guard.consecutive_bad == 3
+    # data iterator resumed at the checkpointed batch position
+    assert it2.state_dict()["cursor"] == saved_cursor
+
+
+def test_module_fit_rollback_without_checkpoint_still_raises(
+        monkeypatch):
+    monkeypatch.setenv("MXTPU_NONFINITE_POLICY", "skip")
+    monkeypatch.setenv("MXTPU_MAX_BAD_STEPS", "2")
+    monkeypatch.setenv("MXTPU_FAULT_SPEC", "grad:nonfinite:*:inf")
+    rz.reset_faults()
+    it, sym = _toy_module_problem()
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with pytest.raises(rz.DivergedError):
+            mod.fit(it, num_epoch=1, optimizer="sgd",
+                    initializer=mx.initializer.Xavier())
+
+
+# ---------------------------------------------------------------- exits
+def test_diverged_exithook_distinct_exit_code():
+    code = ("import incubator_mxnet_tpu.resilience as rz\n"
+            "rz.install_diverged_exithook()\n"
+            "raise rz.DivergedError('test divergence')\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([sys.executable, "-c", code],
+                       capture_output=True, text=True, timeout=120,
+                       env=env, cwd=REPO)
+    assert r.returncode == rz.DivergedError.EXIT_CODE, \
+        (r.returncode, r.stderr[-500:])
+    assert "DivergedError" in r.stderr
+    # ordinary exceptions keep the generic code
+    r2 = subprocess.run(
+        [sys.executable, "-c",
+         "import incubator_mxnet_tpu.resilience as rz\n"
+         "rz.install_diverged_exithook()\nraise ValueError('x')\n"],
+        capture_output=True, text=True, timeout=120, env=env,
+        cwd=REPO)
+    assert r2.returncode == 1
+
+
+def test_launch_exports_sentinel_flags():
+    """--nonfinite-policy/--max-bad-steps reach the worker env (the
+    yarn print mode shows the exact per-worker command line)."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--launcher", "yarn",
+         "--nonfinite-policy", "skip", "--max-bad-steps", "5",
+         "--", "python", "train.py"],
+        capture_output=True, text=True, timeout=60, cwd=REPO)
+    assert r.returncode == 0, r.stderr
+    assert "MXTPU_NONFINITE_POLICY=skip" in r.stdout
+    assert "MXTPU_MAX_BAD_STEPS=5" in r.stdout
+
+
+# ---------------------------------------------------------------- multi
+def test_multirank_skip_decisions_agree():
+    """Two ranks, fault injection on rank 0 only: the allreduced
+    finiteness flag must make BOTH ranks skip the same steps."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--", sys.executable,
+         os.path.join(REPO, "tests", "dist_worker_sentinel.py")],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=REPO)
+    out = r.stdout + r.stderr
+    if r.returncode != 0 and (
+            "implemented" in out or "UNIMPLEMENTED" in out):
+        pytest.skip("multi-process collectives unavailable on this "
+                    "backend")
+    assert r.returncode == 0, out[-3000:]
+    assert "SENTINEL_OK rank 0" in out, out[-3000:]
+    assert "SENTINEL_OK rank 1" in out, out[-3000:]
+
+
+# ---------------------------------------------------------------- satellites
+def test_clip_global_norm_nonfinite_safe():
+    from incubator_mxnet_tpu.gluon.utils import clip_global_norm
+    a = mx.nd.array(np.full((4,), 3.0, np.float32))
+    b = mx.nd.array(np.full((4,), 4.0, np.float32))
+    total = clip_global_norm([a, b], max_norm=1.0)
+    assert math.isclose(total, 10.0, rel_tol=1e-5)
+    assert np.allclose(a.asnumpy(), 3.0 / 10.0, atol=1e-5)
+
+    bad = mx.nd.array(np.array([1.0, np.nan], np.float32))
+    ok = mx.nd.array(np.full((2,), 2.0, np.float32))
+    before = ok.asnumpy().copy()
+    with pytest.warns(RuntimeWarning, match="non-finite"):
+        total = clip_global_norm([ok, bad], max_norm=1.0)
+    assert not math.isfinite(total)         # caller can skip the step
+    np.testing.assert_allclose(ok.asnumpy(), before)  # untouched
+    # check_isfinite=False keeps the legacy silent behavior
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        total = clip_global_norm([ok, bad], max_norm=1.0,
+                                 check_isfinite=False)
+    assert not math.isfinite(total)
+
+
+def test_metric_running_sums_exclude_nonfinite_batches():
+    m = mx.metric.MSE()
+    good_l = [mx.nd.array(np.ones((4, 1), np.float32))]
+    good_p = [mx.nd.array(np.full((4, 1), 2.0, np.float32))]
+    m.update(good_l, good_p)
+    with pytest.warns(RuntimeWarning, match="non-finite"):
+        m.update([mx.nd.array(np.array([[np.nan]] * 4, np.float32))],
+                 good_p)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")     # warned once only
+        m.update([mx.nd.array(np.array([[np.inf]] * 4, np.float32))],
+                 good_p)
+    m.update(good_l, good_p)
+    name, val = m.get()
+    assert math.isfinite(val) and math.isclose(val, 1.0)
+    assert m.num_nonfinite == 2
+    m.reset()
+    assert m.num_nonfinite == 0
+
+    # cross-entropy path: NaN probabilities are excluded the same way
+    ce = mx.metric.CrossEntropy()
+    label = [mx.nd.array(np.zeros((2,), np.float32))]
+    ce.update(label, [mx.nd.array(np.full((2, 2), 0.5, np.float32))])
+    with pytest.warns(RuntimeWarning):
+        ce.update(label,
+                  [mx.nd.array(np.full((2, 2), np.nan, np.float32))])
+    assert math.isfinite(ce.get()[1])
+    assert ce.num_nonfinite == 1
+
+
+def test_monitor_nonfinite_count_localizes_bad_op():
+    from incubator_mxnet_tpu import monitor as mon_mod
+    mon = mx.monitor.Monitor(interval=1,
+                             stat_func=mon_mod.nonfinite_count)
+    mon.install()
+    try:
+        mon.tic()
+        ok = mx.nd.exp(mx.nd.array(np.zeros((2,), np.float32)))
+        bad = mx.nd.log(mx.nd.array(np.array([-1.0, 1.0], np.float32)))
+        _ = ok + bad
+    finally:
+        mon.uninstall()
+    rows = {name: stat for _, name, stat in mon.queue}
+    assert rows.get("exp") == 0              # clean op reads 0
+    assert rows.get("log") == 1              # first poisoned op
+    assert any(name not in ("exp", "log") and stat >= 1
+               for name, stat in rows.items()
+               if name != "exp")             # contamination flows on
+
+
+def test_monitor_default_stat_nan_tolerant():
+    from incubator_mxnet_tpu.monitor import _default_stat
+    x = np.array([1.0, -3.0, np.nan, np.inf], np.float32)
+    assert math.isclose(_default_stat(x), 2.0)   # mean over finite
+    assert math.isnan(_default_stat(np.array([np.nan], np.float32)))
+    assert math.isclose(_default_stat(np.array([2, 4], np.int32)),
+                        3.0)
+
+
+def test_lint_flags_host_sync_in_guarded_hot_paths(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "ci"))
+    try:
+        import lint
+    finally:
+        sys.path.pop(0)
+    d = tmp_path / "incubator_mxnet_tpu" / "gluon"
+    d.mkdir(parents=True)
+    f = d / "trainer.py"
+    f.write_text("def step(self, n):\n"
+                 "    v = self._flag.item()\n"
+                 "    return v\n")
+    problems = lint.check_file(f)
+    assert any("host sync" in p for p in problems), problems
+    # sync-ok annotation exempts the guard-interval read
+    f.write_text("def step(self, n):\n"
+                 "    v = self._flag.item()  # sync-ok: interval\n"
+                 "    return v\n")
+    assert not any("host sync" in p for p in lint.check_file(f))
+    # helper functions outside the hot set are untouched
+    f.write_text("def save_states(self):\n"
+                 "    return self._flag.item()\n")
+    assert not any("host sync" in p for p in lint.check_file(f))
+    # jnp.asarray (host->device) is not flagged; np.asarray is
+    f.write_text("import numpy as np\nimport jax.numpy as jnp\n"
+                 "def update(self, g):\n"
+                 "    a = jnp.asarray(g)\n"
+                 "    b = np.asarray(g)\n"
+                 "    return a, b\n")
+    problems = lint.check_file(f)
+    assert sum("host sync" in p for p in problems) == 1, problems

@@ -46,6 +46,12 @@ import sys
 import time
 
 
+# resilience.DivergedError.EXIT_CODE, mirrored by value: the
+# launcher deliberately never imports the package (it must run
+# before jax is installed/importable on a fresh host)
+DIVERGED_EXIT = 13
+
+
 def _free_port():
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -92,6 +98,10 @@ def _worker_env(args, rank, coord, attempt):
         # clean, restartable exit) while its heartbeat is still
         # beating — heartbeats only catch wedged *processes*
         env["MXTPU_DATA_TIMEOUT"] = str(args.data_timeout)
+    if getattr(args, "nonfinite_policy", None):
+        env["MXTPU_NONFINITE_POLICY"] = args.nonfinite_policy
+    if getattr(args, "max_bad_steps", None) is not None:
+        env["MXTPU_MAX_BAD_STEPS"] = str(args.max_bad_steps)
     for kv in args.env:
         if "=" not in kv:
             raise ValueError(f"--env wants KEY=VALUE, got {kv!r}")
@@ -246,6 +256,17 @@ def main():
                     "seconds raise DataPipelineError (a restartable "
                     "failure) instead of hanging; unset leaves the "
                     "workers' own env/default")
+    ap.add_argument("--nonfinite-policy", default=None,
+                    choices=["off", "warn", "skip", "raise"],
+                    help="export MXTPU_NONFINITE_POLICY to every "
+                    "worker: arm the training-step sentinel (skip "
+                    "non-finite updates, detect divergence — "
+                    "docs/numeric_stability.md)")
+    ap.add_argument("--max-bad-steps", type=int, default=None,
+                    help="export MXTPU_MAX_BAD_STEPS to every "
+                    "worker: consecutive non-finite steps before a "
+                    "worker rolls back to its newest valid "
+                    "checkpoint and exits with the divergence code")
     ap.add_argument("--max-restarts", type=int, default=0,
                     help="elastic mode: relaunch the whole job up to "
                     "N times after a worker failure (workers resume "
@@ -369,11 +390,19 @@ def main():
         for attempt in range(1, args.max_restarts + 1):
             if rc == 0:
                 break
-            print(f"launch.py: restarting job (attempt {attempt}/"
-                  f"{args.max_restarts}); workers should resume from "
-                  "their last checkpoint (params + optimizer .states "
-                  "+ input-pipeline .data companions)",
-                  file=sys.stderr)
+            if rc == DIVERGED_EXIT:
+                print(f"launch.py: worker reported DIVERGENCE (exit "
+                      f"{rc}: MXTPU_MAX_BAD_STEPS consecutive "
+                      "non-finite steps); params were rolled back to "
+                      "the newest valid checkpoint — restarting "
+                      f"(attempt {attempt}/{args.max_restarts}) "
+                      "resumes from it", file=sys.stderr)
+            else:
+                print(f"launch.py: restarting job (attempt {attempt}/"
+                      f"{args.max_restarts}); workers should resume "
+                      "from their last checkpoint (params + optimizer "
+                      ".states + input-pipeline .data companions)",
+                      file=sys.stderr)
             rc = _run_once(make_spawners(coord_for(attempt), attempt),
                            hb_files(attempt), args.heartbeat_timeout)
         return rc
