@@ -66,6 +66,30 @@ SYNC_ROOT_ATTRS = {("np", "asarray"), ("numpy", "asarray"),
 # markers etc.) — exempt from the env-var documentation check.
 NON_ENV_TOKENS = {"MXTPU_KILLED"}
 
+# Instrumented hot-path modules (docs/observability.md).  In these,
+# raw ``time.perf_counter()`` section timing is forbidden: wall-time
+# sections must go through ``telemetry.span`` so they land in the
+# registry AND the chrome-tracing timeline instead of a private
+# variable nobody can see.  Lines annotated `# timing-ok: <why>` are
+# exempt (telemetry.py and profiler.py — the timing backends — are
+# not listed).
+SPAN_TIMING_MODULES = (
+    "incubator_mxnet_tpu/module/base_module.py",
+    "incubator_mxnet_tpu/module/module.py",
+    "incubator_mxnet_tpu/gluon/trainer.py",
+    "incubator_mxnet_tpu/model.py",
+    "incubator_mxnet_tpu/callback.py",
+    "incubator_mxnet_tpu/monitor.py",
+    "incubator_mxnet_tpu/io/io.py",
+    "incubator_mxnet_tpu/gluon/data/dataloader.py",
+)
+
+# telemetry metric factories: a string literal passed to one of these
+# is a metric (or span) name and must be declared in the catalog
+# table of docs/observability.md — same discipline as the env-var
+# registry, so `snapshot()` output is always documented.
+METRIC_FACTORIES = {"counter", "gauge", "histogram", "span"}
+
 
 def _is_binary_write_open(node):
     """True for ``open(..., "wb"/"wb+"/...)`` calls."""
@@ -174,6 +198,23 @@ def check_file(path):
     if any(posix.endswith(m) for m in HOT_SYNC_FILES):
         problems.extend(
             _hot_sync_problems(path, tree, src.splitlines()))
+    if any(posix.endswith(m) for m in SPAN_TIMING_MODULES):
+        lines = src.splitlines()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "perf_counter" \
+                    and _attr_root(node.func.value) == "time":
+                line = lines[node.lineno - 1] \
+                    if node.lineno - 1 < len(lines) else ""
+                if "timing-ok" in line:
+                    continue
+                problems.append(
+                    f"{path}:{node.lineno}: raw time.perf_counter() "
+                    "in an instrumented hot-path module — time the "
+                    "section with telemetry.span(...) so it lands in "
+                    "the registry and the trace timeline, or "
+                    "annotate the line with '# timing-ok: <why>'")
 
     for node in ast.walk(tree):
         if in_ckpt_module and _is_binary_write_open(node):
@@ -297,6 +338,48 @@ def check_env_vars(files):
     return sorted(set(problems))
 
 
+def check_metric_catalog(files):
+    """Every metric/span name created via the telemetry registry —
+    a string literal passed to counter()/gauge()/histogram()/span()
+    — must be declared (backtick-quoted) in the catalog table of
+    docs/observability.md, mirroring the env-var lint: an operator
+    reading a snapshot must always find the metric's meaning."""
+    import re
+    docs = Path("docs/observability.md")
+    if not docs.exists():
+        return []
+    catalog = docs.read_text()
+    name_re = re.compile(r"^[a-z][a-z0-9_]*$")
+    problems = []
+    for path in files:
+        posix = path.as_posix()
+        # substring, not prefix: unit tests feed tmp-dir copies of
+        # framework files (same pattern as the hot-sync rule)
+        if "incubator_mxnet_tpu" not in posix \
+                and "tools" not in posix:
+            continue
+        try:
+            tree = ast.parse(path.read_text())
+        except SyntaxError:
+            continue        # reported by check_file
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            fn = node.func
+            fname = fn.id if isinstance(fn, ast.Name) else \
+                fn.attr if isinstance(fn, ast.Attribute) else ""
+            name = node.args[0].value
+            if fname in METRIC_FACTORIES and name_re.match(name) \
+                    and f"`{name}`" not in catalog:
+                problems.append(
+                    f"{path}:{node.lineno}: metric/span name "
+                    f"{name!r} is not declared in the catalog table "
+                    "of docs/observability.md")
+    return sorted(set(problems))
+
+
 def main(argv):
     roots = argv or DEFAULT_PATHS
     files = []
@@ -310,6 +393,7 @@ def main(argv):
     for f in files:
         problems.extend(check_file(f))
     problems.extend(check_env_vars(files))
+    problems.extend(check_metric_catalog(files))
     for p in problems:
         print(p)
     print(f"lint: {len(files)} files, {len(problems)} problems")

@@ -47,6 +47,7 @@ from . import model
 from . import test_utils
 from . import dist
 from . import resilience
+from . import telemetry
 from . import predictor
 from .predictor import Predictor
 from .model import load_checkpoint, save_checkpoint
@@ -74,5 +75,5 @@ __all__ = ["nd", "ndarray", "autograd", "Context", "cpu", "tpu", "gpu",
            "lr_scheduler", "metric", "callback", "kvstore", "model",
            "module", "mod", "Module", "gluon", "DataBatch", "DataDesc",
            "DataIter", "NDArrayIter", "load_checkpoint",
-           "save_checkpoint", "list_env", "resilience",
+           "save_checkpoint", "list_env", "resilience", "telemetry",
            "__version__"]

@@ -41,25 +41,47 @@ def log_train_metric(period, auto_reset=False):
 
 
 class Speedometer:
-    """Throughput logger (ref: callback.py Speedometer)."""
+    """Throughput logger (ref: callback.py Speedometer).
+
+    Samples/sec and epoch progress are also published through the
+    telemetry registry (`throughput_samples_per_sec`, `epoch`,
+    `nbatch` — docs/observability.md), making the registry the single
+    source of truth for throughput: the tensorboard bridge, the
+    emitter's JSONL stream, and launch.py's cluster status line all
+    read the same number this logger prints.
+
+    The measured window is the *actual* batch count since the last
+    measurement (``count - tic_count``), not ``frequent``: when the
+    first callback arrives at a nonzero nbatch (resumed stream,
+    callback installed late), the old ``frequent``-batch numerator
+    over a shorter window inflated the first reported rate."""
 
     def __init__(self, batch_size, frequent=50, auto_reset=True):
         self.batch_size = batch_size
         self.frequent = frequent
         self.init = False
         self.tic = 0
+        self.tic_count = 0
         self.last_count = 0
         self.auto_reset = auto_reset
 
     def __call__(self, param):
+        from . import telemetry
         count = param.nbatch
         if self.last_count > count:
             self.init = False
         self.last_count = count
         if self.init:
             if count % self.frequent == 0:
-                speed = self.frequent * self.batch_size / (
-                    time.time() - self.tic)
+                window = count - self.tic_count
+                elapsed = time.time() - self.tic
+                if window <= 0 or elapsed <= 0:
+                    return
+                speed = window * self.batch_size / elapsed
+                telemetry.gauge(
+                    "throughput_samples_per_sec").set(speed)
+                telemetry.gauge("epoch").set(param.epoch)
+                telemetry.gauge("nbatch").set(count)
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()
                     if self.auto_reset:
@@ -73,9 +95,11 @@ class Speedometer:
                         "Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
                         param.epoch, count, speed)
                 self.tic = time.time()
+                self.tic_count = count
         else:
             self.init = True
             self.tic = time.time()
+            self.tic_count = count
 
 
 class ProgressBar:

@@ -15,7 +15,7 @@ import json
 import os
 import time
 
-__all__ = ["LogMetricsCallback", "make_writer"]
+__all__ = ["LogMetricsCallback", "make_writer", "log_telemetry"]
 
 
 class _JsonlWriter:
@@ -47,6 +47,32 @@ def make_writer(logdir):
         return SummaryWriter(logdir)
     except Exception:
         return _JsonlWriter(logdir)
+
+
+def log_telemetry(writer, snapshot=None, step=None):
+    """Write a telemetry registry snapshot's gauges (and counters) as
+    TensorBoard scalars, tagged ``telemetry/<name>``.
+
+    ``snapshot`` defaults to a fresh ``telemetry.snapshot()``;
+    ``step`` defaults to the snapshot's ``train_steps_total`` counter
+    so successive calls land on the training-step axis.  Returns the
+    number of scalars written — 0 with telemetry disabled."""
+    from .. import telemetry
+    if snapshot is None:
+        if not telemetry.enabled():
+            return 0
+        snapshot = telemetry.snapshot()
+    if step is None:
+        step = int(snapshot.get("counters", {})
+                   .get("train_steps_total", 0))
+    written = 0
+    for name, value in sorted(snapshot.get("gauges", {}).items()):
+        writer.add_scalar(f"telemetry/{name}", value, step)
+        written += 1
+    for name, value in sorted(snapshot.get("counters", {}).items()):
+        writer.add_scalar(f"telemetry/{name}", value, step)
+        written += 1
+    return written
 
 
 class LogMetricsCallback:

@@ -71,8 +71,12 @@ def init(coordinator_address=None, num_workers_=None, rank_=None):
     in a collective.
     """
     global _initialized
-    from . import resilience
+    from . import resilience, telemetry
     resilience.start_heartbeat()
+    # per-worker telemetry: snapshots ride the heartbeat file for the
+    # launcher's aggregation; the JSONL emitter additionally starts
+    # here when MXTPU_TELEMETRY_FILE is set (docs/observability.md)
+    telemetry.maybe_start_emitter()
     # launcher-spawned workers report divergence with a distinct exit
     # code so launch.py's restart loop can tell it from a crash
     resilience.install_diverged_exithook()
@@ -176,6 +180,8 @@ def _guarded(op, tag, body):
         except resilience.ResilienceError:
             raise
         except (RuntimeError, OSError, ConnectionError) as exc:
+            from . import telemetry
+            telemetry.counter("collective_aborts_total").inc()
             raise resilience.CollectiveAbortedError(
                 f"collective {op} (tag={tag} "
                 f"rank={jax.process_index()}) failed in-op: {exc}; "

@@ -444,6 +444,9 @@ class DataLoader:
                                 "(MXTPU_DL_DEAD_GRACE overrides the "
                                 "wait)")
                         restarts_used += 1
+                        from ... import telemetry
+                        telemetry.counter(
+                            "dataloader_worker_restarts_total").inc()
                         warnings.warn(
                             "a DataLoader worker died holding batch "
                             f"{idxs[:4]}{'...' if len(idxs) > 4 else ''}; "

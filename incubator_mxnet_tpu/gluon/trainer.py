@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import optimizer as opt_mod
+from .. import telemetry
 from ..model import _create_kvstore
 from ..parallel import optim as foptim
 
@@ -49,6 +50,7 @@ class Trainer:
         from .. import resilience
         self._scaler = opt_mod.LossScaler()
         self._guard = resilience.NumericGuard(name="gluon.Trainer")
+        telemetry.maybe_start_emitter()
         if self._scaler.dynamic and not self._guard.enabled:
             # dynamic loss scaling IS skip-on-overflow: the scaler's
             # overflow signal is the guard's finiteness flag, and an
@@ -219,36 +221,45 @@ class Trainer:
                 f"Gradient of Parameter `{missing[0].name}` not set; "
                 "call backward first, or set ignore_stale_grad=True")
 
+        telemetry.counter("train_steps_total").inc()
         guarded = self._guard.enabled
         if self._fused_active():
-            params = {p.name: p.data()._data for p in self._params}
-            grads = {p.name: (p._grad._data if p._grad is not None
-                              else jnp.zeros_like(p.data()._data))
-                     for p in self._params}
-            if guarded:
-                poison = opt_mod.grad_poison()
-                if poison is not None:
-                    first = next(iter(grads))
-                    grads[first] = grads[first] * poison
-            fn = self._fused_variant(
-                tuple(sorted(p.name for p in missing)), guarded,
-                self._guard.drops_updates)
-            out = fn(
-                params, grads, self._fstate,
-                jnp.asarray(self._optimizer.rescale_grad, jnp.float32),
-                jnp.asarray(foptim.scheduled_lr(self._optimizer),
-                            jnp.float32))
-            if guarded:
-                new_p, self._fstate, flag = out
-            else:
-                new_p, self._fstate = out
-            for p in self._params:
-                p._data._data = new_p[p.name]
+            with telemetry.span("optimizer"):
+                params = {p.name: p.data()._data
+                          for p in self._params}
+                grads = {p.name: (p._grad._data
+                                  if p._grad is not None
+                                  else jnp.zeros_like(p.data()._data))
+                         for p in self._params}
+                if guarded:
+                    poison = opt_mod.grad_poison()
+                    if poison is not None:
+                        first = next(iter(grads))
+                        grads[first] = grads[first] * poison
+                fn = self._fused_variant(
+                    tuple(sorted(p.name for p in missing)), guarded,
+                    self._guard.drops_updates)
+                out = fn(
+                    params, grads, self._fstate,
+                    jnp.asarray(self._optimizer.rescale_grad,
+                                jnp.float32),
+                    jnp.asarray(foptim.scheduled_lr(self._optimizer),
+                                jnp.float32))
+                if guarded:
+                    new_p, self._fstate, flag = out
+                else:
+                    new_p, self._fstate = out
+                for p in self._params:
+                    p._data._data = new_p[p.name]
             if guarded:
                 due = self._guard.begin_step()
                 opt_mod.accumulate_window(self._guard, flag)
                 if due:
-                    bad = opt_mod.read_window_bad(self._guard)
+                    # the guard-interval read is the step's one
+                    # device->host transfer — the 'host_sync' slice
+                    # of the step timeline (docs/observability.md)
+                    with telemetry.span("host_sync"):
+                        bad = opt_mod.read_window_bad(self._guard)
                     if bad and self._guard.drops_updates:
                         # the in-jit select already dropped those
                         # updates on device; un-advance the LR
@@ -266,18 +277,20 @@ class Trainer:
             if not opt_mod.guarded_step_begin(self._guard,
                                               self._scaler, grads):
                 return
-        for i, p in enumerate(self._params):
-            if p._grad is None:
-                continue
-            if self._kvstore is not None and self._update_on_kvstore:
-                self._kvstore.push(i, p.grad(), priority=-i)
-                self._kvstore.pull(i, out=p.data(), priority=-i)
-            elif self._kvstore is not None:
-                self._kvstore.push(i, p.grad(), priority=-i)
-                self._kvstore.pull(i, out=p.grad(), priority=-i)
-                self._updater(i, p.grad(), p.data())
-            else:
-                self._updater(i, p.grad(), p.data())
+        with telemetry.span("optimizer"):
+            for i, p in enumerate(self._params):
+                if p._grad is None:
+                    continue
+                if self._kvstore is not None and \
+                        self._update_on_kvstore:
+                    self._kvstore.push(i, p.grad(), priority=-i)
+                    self._kvstore.pull(i, out=p.data(), priority=-i)
+                elif self._kvstore is not None:
+                    self._kvstore.push(i, p.grad(), priority=-i)
+                    self._kvstore.pull(i, out=p.grad(), priority=-i)
+                    self._updater(i, p.grad(), p.data())
+                else:
+                    self._updater(i, p.grad(), p.data())
 
     def allreduce_grads(self):
         """Explicit grad reduction without update (API parity; on a

@@ -258,6 +258,8 @@ class ImageRecordIter(DataIter):
         """Count one corrupt record against MXTPU_MAX_BAD_RECORDS:
         skip-and-log within the budget, raise past it."""
         self._bad_records += 1
+        from .. import telemetry
+        telemetry.counter("data_quarantined_records_total").inc()
         budget = get_env("MXTPU_MAX_BAD_RECORDS")
         if self._bad_records > budget:
             raise DataPipelineError(
@@ -469,6 +471,8 @@ class ImageRecordIter(DataIter):
             raise err
         data, label, pad, consumed = item
         self._nbatch += 1
+        from .. import telemetry
+        telemetry.counter("prefetch_batches_total").inc()
         self._records_consumed = consumed
         self._skip_batches = 0   # any replay-discard phase is over
         label_out = label[:, 0] if self.label_width == 1 else label

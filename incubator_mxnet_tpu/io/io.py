@@ -20,6 +20,7 @@ import time
 
 import numpy as np
 
+from .. import telemetry
 from ..ndarray import array as nd_array
 from ..ndarray.ndarray import NDArray
 from ..resilience import DataPipelineError, data_timeout, inject
@@ -42,7 +43,22 @@ def _bounded_get(q, source, thread=None, timeout=None):
     arrives within the deadline — the two ways a background producer
     can otherwise hang its consumer forever.  ``timeout=None`` reads
     the env flag; a value <= 0 disables the deadline (the dead-thread
-    check still applies)."""
+    check still applies).
+
+    Telemetry: each successful get publishes its queue-wait time and
+    the post-get queue depth (`prefetch_queue_wait_seconds` /
+    `prefetch_queue_depth`) — the operator-visible signal for "the
+    input pipeline is the bottleneck"."""
+    t0 = time.monotonic()
+    item = _bounded_get_inner(q, source, thread, timeout)
+    tel_hist = telemetry.histogram("prefetch_queue_wait_seconds")
+    if tel_hist is not telemetry.NULL_METRIC:
+        tel_hist.observe(time.monotonic() - t0)
+        telemetry.gauge("prefetch_queue_depth").set(q.qsize())
+    return item
+
+
+def _bounded_get_inner(q, source, thread, timeout):
     if timeout is None:
         timeout = data_timeout()
     deadline = time.monotonic() + timeout \
@@ -565,6 +581,7 @@ class PrefetchingIter(DataIter):
             raise self._error
         batches = item
         self._delivered += 1
+        telemetry.counter("prefetch_batches_total").inc()
         data = [d for b in batches for d in b.data]
         label = [l for b in batches for l in b.label]
         return DataBatch(data, label, pad=batches[0].pad)
@@ -721,6 +738,7 @@ class DevicePrefetchIter(DataIter):
             self._terminal = (kind, err)
             raise err
         self._delivered += 1
+        telemetry.counter("prefetch_batches_total").inc()
         return payload
 
     def iter_next(self):

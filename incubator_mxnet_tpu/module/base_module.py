@@ -8,6 +8,7 @@ import time
 
 from .. import initializer as init_mod
 from .. import metric as metric_mod
+from .. import telemetry
 from ..io.io import DataBatch
 from ..model import BatchEndParam
 from ..resilience import DivergedError
@@ -167,6 +168,7 @@ class BaseModule:
         relaunch resumes at the right batch — before re-raising for
         the launcher restart loop."""
         assert num_epoch is not None, "num_epoch must be given"
+        telemetry.maybe_start_emitter()
         initializer = initializer or init_mod.Uniform(0.01)
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
@@ -206,12 +208,26 @@ class BaseModule:
             eval_metric.reset()
             nbatch = 0
             train_data.reset()
-            for data_batch in train_data:
+            data_iter = iter(train_data)
+            # per-step timeline (docs/observability.md): data-wait /
+            # forward-backward / optimizer / host-sync spans.  Spans
+            # time wall-clock sections only — no device reads beyond
+            # what the section already performs (update_metric's
+            # host pull, the sentinel's guard-interval read), so the
+            # transfer budget is unchanged.
+            while True:
+                with telemetry.span("data_wait"):
+                    data_batch = next(data_iter, None)
+                if data_batch is None:
+                    break
                 if monitor is not None:
                     monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
-                self.update_metric(eval_metric, data_batch.label)
+                with telemetry.span("forward_backward"):
+                    self.forward_backward(data_batch)
+                with telemetry.span("optimizer"):
+                    self.update()
+                with telemetry.span("host_sync"):
+                    self.update_metric(eval_metric, data_batch.label)
                 if monitor is not None:
                     monitor.toc_print()
                 if batch_end_callback is not None:
@@ -288,6 +304,7 @@ class BaseModule:
         if data_iter is not None and \
                 hasattr(data_iter, "load_state_dict"):
             load_data_state(prefix, eff, data_iter, strict=False)
+        telemetry.counter("rollbacks_total").inc()
         warnings.warn(
             f"training diverged; rolled back to checkpoint epoch "
             f"{eff} of prefix {prefix!r} (params + optimizer + "

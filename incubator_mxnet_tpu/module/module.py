@@ -13,6 +13,7 @@ import logging
 
 from .. import initializer as init_mod
 from .. import optimizer as opt_mod
+from .. import telemetry
 from ..initializer import InitDesc
 from ..model import (_create_kvstore, save_checkpoint,
                      load_checkpoint, checkpoint_companion_path,
@@ -367,6 +368,7 @@ class Module(BaseModule):
         MXTPU_MAX_BAD_STEPS consecutive bad steps raise
         DivergedError for fit's checkpoint rollback."""
         assert self.optimizer_initialized
+        telemetry.counter("train_steps_total").inc()
         if self._mesh_step is not None:
             if self._mesh_pending:
                 # the optimizer already ran inside the fused mesh
@@ -380,7 +382,11 @@ class Module(BaseModule):
                     opt_mod.accumulate_window(
                         self._guard, self._mesh_step.last_finite)
                     if due:
-                        bad = opt_mod.read_window_bad(self._guard)
+                        # the one guard-interval device->host read —
+                        # the 'host_sync' slice of the step timeline
+                        with telemetry.span("host_sync"):
+                            bad = opt_mod.read_window_bad(
+                                self._guard)
                         if bad and self._guard.drops_updates:
                             # those updates were dropped on device;
                             # keep the LR schedule in step with the

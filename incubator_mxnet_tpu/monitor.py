@@ -64,6 +64,7 @@ class Monitor:
         self.activated = False
         self.queue = []
         self._exes = []
+        self._span = None
 
     # ------------------------------------------------------------ install
     def install(self, target=None):
@@ -83,6 +84,9 @@ class Monitor:
 
     def uninstall(self):
         global _active_monitor
+        if self._span is not None:       # armed batch never toc'd
+            self._span.__exit__(None, None, None)
+            self._span = None
         if _active_monitor is self:
             _active_monitor = None
         for exe in self._exes:
@@ -95,12 +99,30 @@ class Monitor:
         if self.step % self.interval == 0:
             self.queue = []
             self.activated = True
+            # armed batches run tapped/un-jitted — materially slower.
+            # The span makes "the debug tap was on here" visible in
+            # the telemetry timeline, so a perf regression that is
+            # really an armed Monitor is diagnosable from the trace
+            # alone (docs/observability.md).
+            from . import telemetry
+            if self._span is not None:
+                # the prior armed batch aborted between tic and toc
+                # (an exception in forward/update skipped toc): close
+                # its span now so the armed section still lands in
+                # the timeline instead of leaking open — it measures
+                # tic-to-rearm, slightly long, but visible
+                self._span.__exit__(None, None, None)
+            self._span = telemetry.span("monitor_armed")
+            self._span.__enter__()
         self.step += 1
 
     def toc(self):
         if not self.activated:
             return []
         self.activated = False
+        if self._span is not None:
+            self._span.__exit__(None, None, None)
+            self._span = None
         for exe in self._exes:
             if getattr(exe, "_monitor_cb", None) is not None:
                 continue    # tapped: per-op rows already streamed
@@ -118,6 +140,9 @@ class Monitor:
         self.queue = []
         if self.sort:
             res = sorted(res, key=lambda r: r[1])
+        from . import telemetry
+        telemetry.counter("monitor_armed_batches_total").inc()
+        telemetry.counter("monitor_stat_rows_total").inc(len(res))
         return res
 
     def toc_print(self):

@@ -627,7 +627,11 @@ def guarded_step_begin(guard, scaler, grads):
     accumulate_window(guard, all_finite(grads))
     if not due:
         return True
-    bad = read_window_bad(guard)
+    # the guard-interval read is the eager step's one device->host
+    # transfer — the 'host_sync' slice of the step timeline
+    from . import telemetry
+    with telemetry.span("host_sync"):
+        bad = read_window_bad(guard)
     if scaler is not None:
         scaler.update(overflow=bad > 0)
     # dropped=1: on an eager path only the CURRENT step is actually
@@ -681,10 +685,12 @@ class LossScaler:
         use for the *next* step."""
         if not self.dynamic:
             return self.scale
+        from . import telemetry
         if overflow:
             self.scale = max(self.scale * self.backoff, 1.0)
             self._good_steps = 0
             self.num_backoffs += 1
+            telemetry.counter("loss_scale_backoffs_total").inc()
         else:
             self._good_steps += 1
             if self._good_steps >= self.window:
@@ -692,6 +698,8 @@ class LossScaler:
                                  self.max_scale)
                 self._good_steps = 0
                 self.num_growths += 1
+                telemetry.counter("loss_scale_growths_total").inc()
+        telemetry.gauge("loss_scale").set(self.scale)
         return self.scale
 
     def state_dict(self):

@@ -28,6 +28,7 @@ class Profiler:
         self.state = "stop"
         self._events = []
         self._lock = threading.Lock()
+        self._dump_lock = threading.Lock()
         self._t0 = time.perf_counter()
         self._tls = threading.local()
 
@@ -54,13 +55,68 @@ class Profiler:
                 "pid": os.getpid(), "tid": threading.get_ident(),
             })
 
+    def _meta_events(self, events):
+        """chrome-tracing metadata ('M') events: name the process and
+        every thread that recorded an event, so the timeline rows
+        read 'mxtpu rank N' / real thread names instead of bare
+        ids."""
+        pid = os.getpid()
+        rank = os.environ.get("MXTPU_WORKER_RANK", "0")
+        out = [{"name": "process_name", "ph": "M", "pid": pid,
+                "args": {"name": f"mxtpu rank {rank}"}}]
+        names = {t.ident: t.name for t in threading.enumerate()}
+        tids = {e["tid"] for e in events if "tid" in e}
+        for tid in sorted(tids):
+            out.append({"name": "thread_name", "ph": "M", "pid": pid,
+                        "tid": tid,
+                        "args": {"name": names.get(tid,
+                                                   f"thread-{tid}")}})
+        return out
+
+    def _counter_events(self):
+        """Telemetry registry counters/gauges as chrome-tracing
+        counter ('C') events stamped at dump time, so spans and
+        counters land in ONE timeline (docs/observability.md)."""
+        from . import telemetry
+        if not telemetry.enabled():
+            return []
+        snap = telemetry.snapshot()
+        ts = (time.perf_counter() - self._t0) * 1e6
+        pid = os.getpid()
+        events = []
+        for kind in ("counters", "gauges"):
+            for name, value in sorted(snap[kind].items()):
+                events.append({"name": name, "cat": "telemetry",
+                               "ph": "C", "ts": ts, "pid": pid,
+                               "args": {name: value}})
+        return events
+
     def dump(self, finished=True):
-        with self._lock:
-            data = {"traceEvents": list(self._events)}
-            if finished:
-                self._events = []
-        with open(self.filename, "w") as f:
-            json.dump(data, f)
+        """Write the trace.  Concurrent-safe: dumps are serialized
+        (two racing dumps can no longer interleave writes into the
+        same file, where the empty loser used to clobber the winner
+        and lose its events), the snapshot-and-clear is atomic under
+        the event lock (an add_event racing the file write lands in
+        the retained buffer for the *next* dump instead of being
+        dropped), and the file itself is written temp + rename so a
+        reader never sees a torn JSON document."""
+        with self._dump_lock:
+            with self._lock:
+                events = self._events
+                if finished:
+                    self._events = []
+                else:
+                    events = list(events)
+            data = {"traceEvents":
+                    self._meta_events(events) + events
+                    + self._counter_events()}
+            # resilience's mkstemp-based temp+fsync+rename: unique
+            # tmp names (no concurrent-writer collision) and cleanup
+            # on a failed serialize
+            from . import resilience
+            resilience._replace_with_bytes(
+                self.filename, json.dumps(data).encode(),
+                sync_dir=False)
         return self.filename
 
     # -------------------------------------------------- op dispatch hook

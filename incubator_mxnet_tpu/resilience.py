@@ -219,6 +219,10 @@ def retry_call(fn, *args, policy=None, retry_on=(TransientError,),
             if attempt >= len(delays):
                 raise
             name = op_name or getattr(fn, "__name__", "call")
+            # failure path only: the no-retry steady state never
+            # touches the registry (docs/observability.md)
+            from . import telemetry
+            telemetry.counter("retry_attempts_total").inc()
             warnings.warn(
                 f"{name} failed (attempt {attempt + 1}/"
                 f"{len(delays) + 1}: {exc}); retrying in "
@@ -539,17 +543,23 @@ class NumericGuard:
         window of device-checked steps, and the fused paths report
         the window's exact on-device bad count so ``skipped_steps``
         stays truthful."""
+        from . import telemetry
         self.checks += 1
         if finite:
             self.consecutive_bad = 0
+            telemetry.gauge("sentinel_consecutive_bad").set(0)
             return "ok"
         self.bad_steps += 1
         self.consecutive_bad += 1
+        telemetry.counter("sentinel_bad_steps_total").inc()
+        telemetry.gauge("sentinel_consecutive_bad").set(
+            self.consecutive_bad)
         msg = (f"non-finite {what} in guarded step {self.steps} "
                f"({self.name}; consecutive bad: "
                f"{self.consecutive_bad})")
         if self.max_bad_steps > 0 and \
                 self.consecutive_bad >= self.max_bad_steps:
+            telemetry.counter("sentinel_divergences_total").inc()
             raise DivergedError(
                 f"{msg}: {self.max_bad_steps} consecutive bad steps "
                 "— training diverged; roll back to the newest valid "
@@ -562,6 +572,8 @@ class NumericGuard:
                           RuntimeWarning)
             return "ok"
         self.skipped_steps += max(int(dropped), 1)
+        telemetry.counter("sentinel_skipped_steps_total").inc(
+            max(int(dropped), 1))
         if not self._warned_skip:
             warnings.warn(
                 msg + "; skipping the update (weights, optimizer "
@@ -871,9 +883,21 @@ _HB_STATE = {"thread": None, "stop": None, "path": None}
 
 def _beat(path):
     """One heartbeat: atomically refresh ``path`` with a timestamp
-    (rename, so the monitor never reads a partial write)."""
-    _replace_with_bytes(path, f"{time.time():.3f}\n".encode(),
-                        sync_dir=False)
+    (rename, so the monitor never reads a partial write).  When
+    telemetry is on, the worker's current metric snapshot rides along
+    as a second JSON line — launch.py aggregates these into its
+    cluster status line and final run report; mtime-based monitors
+    and first-line parsers are unaffected.  A telemetry failure must
+    never silence the liveness signal."""
+    payload = f"{time.time():.3f}\n"
+    try:
+        from . import telemetry
+        extra = telemetry.heartbeat_payload()
+        if extra:
+            payload += extra + "\n"
+    except Exception:
+        pass
+    _replace_with_bytes(path, payload.encode(), sync_dir=False)
 
 
 def start_heartbeat(path=None, interval=None):

@@ -1,0 +1,551 @@
+"""Unified run telemetry: metrics registry, step-timeline spans, and
+periodic snapshot emission (docs/observability.md).
+
+The reference framework's observability is per-op profiling
+(src/engine/profiler.h -> profiler.py here) and the debug Monitor
+(python/mxnet/monitor.py).  Production TPU runs additionally need
+*always-on, low-overhead* run telemetry — the Prometheus-style metric
+registry + trace-span timeline of modern training stacks — so an
+operator can see where time and data are going on a hung or
+slowly-diverging job without attaching a debugger.  Three layers:
+
+- :class:`MetricRegistry` — process-wide Counter / Gauge / Histogram
+  (bounded reservoir) store.  Thread-safe; every accessor degrades to
+  a shared no-op when ``MXTPU_TELEMETRY=0``, so disabled runs pay one
+  env read and nothing else (no locks, no allocation, no writes).
+- :func:`span` — a context manager timing a wall-clock section into
+  the registry (``span_<name>_seconds`` histogram) AND into the
+  chrome://tracing profiler stream when the profiler is running, so
+  coarse step phases and fine per-op events land on one timeline.
+  Spans never touch device values: they cost two ``perf_counter``
+  reads and add NO device->host syncs (the step sentinel's transfer
+  budget — one scalar read per MXTPU_GUARD_INTERVAL — is preserved;
+  proven by the transfer-budget test in tests/test_telemetry.py).
+- :class:`TelemetryEmitter` — a daemon thread flushing periodic JSONL
+  snapshots (``MXTPU_TELEMETRY_FILE``, every
+  ``MXTPU_TELEMETRY_INTERVAL`` seconds, rotated at
+  ``MXTPU_TELEMETRY_MAX_MB``) plus an atomically-replaced
+  Prometheus-style textfile (``<file>.prom``) for node-exporter-style
+  scrapers.
+
+Per-worker snapshots additionally ride the resilience heartbeat files
+(:func:`heartbeat_payload`, appended by ``resilience._beat`` as a
+second line) so ``tools/launch.py`` can aggregate ranks into a
+periodic cluster status line and a final run report without any extra
+channel.
+
+Stdlib-only and import-light (like resilience.py): dist workers can
+import it before jax is up.  Metric *names* are governed: every
+literal name passed to counter()/gauge()/histogram()/span() must be
+declared in the catalog table of docs/observability.md — enforced by
+``ci/lint.py``.
+"""
+import json
+import os
+import threading
+import time
+from collections import deque
+
+from .utils.env import get_env
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricRegistry",
+           "TelemetryEmitter", "enabled", "get_registry", "counter",
+           "gauge", "histogram", "span", "snapshot",
+           "prometheus_text", "heartbeat_payload", "start_emitter",
+           "maybe_start_emitter", "stop_emitter"]
+
+
+def enabled():
+    """Whether telemetry is armed (``MXTPU_TELEMETRY``, default on).
+
+    The disabled fast path is this one env read: every factory below
+    returns the shared no-op metric/span, so instrumented code sites
+    stay branch-free."""
+    return get_env("MXTPU_TELEMETRY")
+
+
+# ---------------------------------------------------------------------------
+# metric primitives
+# ---------------------------------------------------------------------------
+
+
+class Counter:
+    """Monotonically increasing count (events, retries, bad steps)."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n=1):
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self):
+        return self._value
+
+
+class Gauge:
+    """Last-write-wins instantaneous value (queue depth, loss scale)."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name):
+        self.name = name
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v):
+        with self._lock:
+            self._value = float(v)
+
+    @property
+    def value(self):
+        return self._value
+
+
+class Histogram:
+    """Distribution with exact count/sum/min/max and a *bounded*
+    reservoir of the most recent ``max_samples`` observations for
+    percentiles — memory stays O(max_samples) over any run length."""
+
+    __slots__ = ("name", "count", "sum", "min", "max", "_samples",
+                 "_lock")
+
+    def __init__(self, name, max_samples=512):
+        self.name = name
+        self.count = 0
+        self.sum = 0.0
+        self.min = None
+        self.max = None
+        self._samples = deque(maxlen=max_samples)
+        self._lock = threading.Lock()
+
+    def observe(self, v):
+        v = float(v)
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            if self.min is None or v < self.min:
+                self.min = v
+            if self.max is None or v > self.max:
+                self.max = v
+            self._samples.append(v)
+
+    def percentile(self, q):
+        with self._lock:
+            data = sorted(self._samples)
+        if not data:
+            return None
+        idx = min(len(data) - 1, max(0, int(q * (len(data) - 1))))
+        return data[idx]
+
+    def stats(self):
+        with self._lock:
+            data = sorted(self._samples)
+            out = {"count": self.count, "sum": self.sum,
+                   "min": self.min, "max": self.max}
+        for tag, q in (("p50", 0.5), ("p90", 0.9), ("p99", 0.99)):
+            out[tag] = (data[min(len(data) - 1,
+                                 int(q * (len(data) - 1)))]
+                        if data else None)
+        return out
+
+
+class _NullMetric:
+    """Shared no-op stand-in for every metric type while telemetry is
+    disabled — instrumented sites call inc/set/observe unconditionally
+    and this absorbs them with zero state."""
+
+    __slots__ = ()
+
+    def inc(self, n=1):
+        pass
+
+    def set(self, v):
+        pass
+
+    def observe(self, v):
+        pass
+
+    @property
+    def value(self):
+        return 0
+
+
+NULL_METRIC = _NullMetric()
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+
+class MetricRegistry:
+    """Process-wide named-metric store.
+
+    Creation is get-or-create keyed by name (one Counter object per
+    name for the process lifetime — callers may cache the returned
+    object); a name re-requested as a different type raises, because
+    two writers disagreeing on a metric's type is always a bug."""
+
+    def __init__(self):
+        self._metrics = {}
+        self._lock = threading.Lock()
+
+    def _get(self, name, cls, **kwargs):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, **kwargs)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, requested {cls.__name__}")
+            return m
+
+    def counter(self, name):
+        return self._get(name, Counter)
+
+    def gauge(self, name):
+        return self._get(name, Gauge)
+
+    def histogram(self, name, max_samples=512):
+        return self._get(name, Histogram, max_samples=max_samples)
+
+    def reset(self):
+        """Drop every metric (test isolation)."""
+        with self._lock:
+            self._metrics.clear()
+
+    def snapshot(self):
+        """One coherent host-side snapshot: counters, gauges, and
+        histogram stats, stamped with wall time and worker rank.  No
+        device access of any kind happens here."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        counters, gauges, hists = {}, {}, {}
+        for m in metrics:
+            if isinstance(m, Counter):
+                counters[m.name] = m.value
+            elif isinstance(m, Gauge):
+                gauges[m.name] = m.value
+            else:
+                hists[m.name] = m.stats()
+        try:
+            rank = int(os.environ.get("MXTPU_WORKER_RANK", "0") or 0)
+        except ValueError:
+            rank = 0
+        return {"ts": time.time(), "rank": rank,
+                "counters": counters, "gauges": gauges,
+                "histograms": hists}
+
+    def prometheus_text(self, prefix="mxtpu_"):
+        """Prometheus exposition-format text of the current state
+        (counters/gauges as-is, histograms as summary _count/_sum)."""
+        snap = self.snapshot()
+        lines = []
+        for name, v in sorted(snap["counters"].items()):
+            lines.append(f"# TYPE {prefix}{name} counter")
+            lines.append(f"{prefix}{name} {v}")
+        for name, v in sorted(snap["gauges"].items()):
+            lines.append(f"# TYPE {prefix}{name} gauge")
+            lines.append(f"{prefix}{name} {v}")
+        for name, st in sorted(snap["histograms"].items()):
+            lines.append(f"# TYPE {prefix}{name} summary")
+            lines.append(f"{prefix}{name}_count {st['count']}")
+            lines.append(f"{prefix}{name}_sum {st['sum']}")
+        return "\n".join(lines) + "\n"
+
+
+_REGISTRY = MetricRegistry()
+
+
+def get_registry():
+    return _REGISTRY
+
+
+def counter(name):
+    """Process-wide counter, or the shared no-op when disabled."""
+    if not enabled():
+        return NULL_METRIC
+    return _REGISTRY.counter(name)
+
+
+def gauge(name):
+    if not enabled():
+        return NULL_METRIC
+    return _REGISTRY.gauge(name)
+
+
+def histogram(name, max_samples=512):
+    if not enabled():
+        return NULL_METRIC
+    return _REGISTRY.histogram(name, max_samples=max_samples)
+
+
+def snapshot():
+    return _REGISTRY.snapshot()
+
+
+def prometheus_text():
+    return _REGISTRY.prometheus_text()
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+
+class _NullSpan:
+    """No-op span: the disabled-mode (and re-enterable) singleton."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    """Times one wall-clock section into the registry histogram
+    ``span_<name>_seconds`` and, when the profiler is running, into
+    its chrome://tracing stream (category 'span') so step phases and
+    per-op events share a timeline.  Host-side timing only — never
+    reads a device value."""
+
+    __slots__ = ("name", "_t0")
+
+    def __init__(self, name):
+        self.name = name
+        self._t0 = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if self._t0 is None:
+            return False
+        t1 = time.perf_counter()
+        _REGISTRY.histogram(
+            f"span_{self.name}_seconds").observe(t1 - self._t0)
+        prof = _profiler()
+        if prof is not None and prof.running:
+            prof.add_event(self.name, self._t0, t1, category="span")
+        self._t0 = None
+        return False
+
+
+def _profiler():
+    # lazy: profiler.py never imports telemetry at module level, so
+    # this direction stays cycle-free; cache after first resolve
+    global _PROF
+    if _PROF is None:
+        from . import profiler as _p
+        _PROF = _p._profiler
+    return _PROF
+
+
+_PROF = None
+
+
+def span(name):
+    """``with telemetry.span("data_wait"): ...`` — see :class:`_Span`.
+    Returns the shared no-op span when telemetry is disabled."""
+    if not enabled():
+        return NULL_SPAN
+    return _Span(name)
+
+
+# ---------------------------------------------------------------------------
+# emitter
+# ---------------------------------------------------------------------------
+
+
+class TelemetryEmitter:
+    """Background flusher: every ``interval`` seconds append one JSONL
+    snapshot line to ``path`` (rotated to ``path + '.1'`` past
+    ``max_bytes``) and atomically replace the Prometheus textfile
+    ``path + '.prom'`` (temp + ``os.replace``, so a scraper never
+    reads a torn file).  ``stop()`` performs a final flush so
+    short-lived runs still leave a complete record."""
+
+    def __init__(self, path=None, interval=None, registry=None,
+                 max_bytes=None):
+        self.path = path or get_env("MXTPU_TELEMETRY_FILE") or None
+        self.interval = float(
+            interval if interval is not None
+            else get_env("MXTPU_TELEMETRY_INTERVAL"))
+        self.registry = registry or _REGISTRY
+        self.max_bytes = int(
+            max_bytes if max_bytes is not None
+            else get_env("MXTPU_TELEMETRY_MAX_MB") * 1024 * 1024)
+        self.flushes = 0
+        self._stop = threading.Event()
+        self._thread = None
+        self._flush_lock = threading.Lock()
+
+    @property
+    def running(self):
+        return self._thread is not None and self._thread.is_alive()
+
+    def start(self):
+        """Spawn the flusher daemon (no-op without a path or when
+        telemetry is disabled); returns self."""
+        if self.path is None or not enabled() or self.running:
+            return self
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(self.interval):
+                try:
+                    self.flush()
+                except OSError:
+                    pass    # target dir vanished mid-teardown
+
+        self._thread = threading.Thread(
+            target=loop, daemon=True, name="mxtpu-telemetry-emitter")
+        self._thread.start()
+        return self
+
+    def flush(self):
+        """One snapshot -> JSONL append (+rotation) + prom rewrite."""
+        if self.path is None:
+            return None
+        snap = self.registry.snapshot()
+        line = json.dumps(snap, sort_keys=True)
+        with self._flush_lock:
+            self._rotate_if_needed(len(line) + 1)
+            with open(self.path, "a") as f:
+                f.write(line + "\n")
+                f.flush()
+            self._write_prom()
+            self.flushes += 1
+        return snap
+
+    def _rotate_if_needed(self, incoming):
+        if self.max_bytes <= 0:
+            return
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return
+        if size + incoming > self.max_bytes:
+            os.replace(self.path, self.path + ".1")
+
+    def _write_prom(self):
+        """Atomic textfile rewrite: a scraper (or a crash) never
+        observes a partial exposition.  Reuses resilience's
+        mkstemp-based temp+fsync+rename helper — a fixed tmp name
+        would collide under concurrent writers and leak on a failed
+        serialize (sync_dir=False: freshness-based like heartbeats,
+        staleness after power loss is moot)."""
+        from . import resilience
+        resilience._replace_with_bytes(
+            self.path + ".prom",
+            self.registry.prometheus_text().encode(), sync_dir=False)
+
+    def stop(self):
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None and t.is_alive():
+            t.join(timeout=5)
+        if self.path is not None and enabled():
+            try:
+                self.flush()
+            except OSError:
+                pass
+
+
+_EMITTER_LOCK = threading.Lock()
+_EMITTER = {"obj": None, "atexit": False}
+
+
+def _emitter_path():
+    """Resolve the JSONL target: ``MXTPU_TELEMETRY_FILE``, suffixed
+    ``.rank<N>`` for nonzero-rank workers — the launcher exports one
+    path to every worker, and concurrent emitters on a shared file
+    would race the rotation and tear each other's textfile.  Rank 0
+    (and single-process runs) keep the bare path."""
+    path = get_env("MXTPU_TELEMETRY_FILE") or None
+    if path is None:
+        return None
+    try:
+        rank = int(os.environ.get("MXTPU_WORKER_RANK", "0") or 0)
+    except ValueError:
+        rank = 0
+    return f"{path}.rank{rank}" if rank > 0 else path
+
+
+def start_emitter(path=None, interval=None):
+    """Start the process-wide emitter (idempotent for the same path;
+    a new path stops the old emitter and re-targets — the same
+    contract as resilience.start_heartbeat).  Registers an atexit
+    final flush, so even a run shorter than the flush interval
+    leaves a complete JSONL + textfile record.  Returns the emitter,
+    or None when disabled / no path configured."""
+    if not enabled():
+        return None
+    path = path or _emitter_path()
+    if path is None:
+        return None
+    with _EMITTER_LOCK:
+        cur = _EMITTER["obj"]
+        if cur is not None and cur.running:
+            if cur.path == path:
+                return cur
+            cur.stop()
+        if not _EMITTER["atexit"]:
+            import atexit
+            atexit.register(stop_emitter)
+            _EMITTER["atexit"] = True
+        em = TelemetryEmitter(path=path, interval=interval)
+        em.start()
+        _EMITTER["obj"] = em
+        return em
+
+
+def maybe_start_emitter():
+    """Fit-loop hook: start the emitter iff telemetry is on and
+    ``MXTPU_TELEMETRY_FILE`` is set.  Steady-state cost when already
+    running (or disabled): an env read and a lock-free check."""
+    if not enabled():
+        return None
+    cur = _EMITTER["obj"]
+    if cur is not None and cur.running and cur.path == _emitter_path():
+        return cur
+    return start_emitter()
+
+
+def stop_emitter():
+    """Stop the process-wide emitter (final flush included)."""
+    with _EMITTER_LOCK:
+        em, _EMITTER["obj"] = _EMITTER["obj"], None
+    if em is not None:
+        em.stop()
+
+
+# ---------------------------------------------------------------------------
+# heartbeat ride-along
+# ---------------------------------------------------------------------------
+
+
+def heartbeat_payload():
+    """Compact one-line JSON snapshot appended to the per-worker
+    heartbeat file by ``resilience._beat`` (line 1 stays the bare
+    timestamp, so mtime-based monitors and old parsers are
+    untouched).  ``tools/launch.py`` reads these to aggregate ranks.
+    Empty string when telemetry is disabled."""
+    if not enabled():
+        return ""
+    return json.dumps(_REGISTRY.snapshot(), sort_keys=True)

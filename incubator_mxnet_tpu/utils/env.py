@@ -143,6 +143,28 @@ register_env("MXTPU_LOSS_SCALE_WINDOW", int, 2000,
 register_env("MXTPU_LOSS_SCALE_MAX", float, float(2 ** 24),
              "upper bound for the dynamic loss scale")
 
+# Telemetry (telemetry.py; docs/observability.md).
+register_env("MXTPU_TELEMETRY", bool, True,
+             "process-wide metrics registry + step-timeline spans "
+             "(docs/observability.md); 0 disables every registry "
+             "write, span, and emitter thread — instrumented paths "
+             "become no-ops")
+register_env("MXTPU_TELEMETRY_FILE", str, "",
+             "path the TelemetryEmitter appends periodic JSONL "
+             "snapshots to (a Prometheus textfile is kept at "
+             "<file>.prom); nonzero-rank workers write to "
+             "<file>.rank<N> so a launcher-shared path never has "
+             "two writers; empty disables the emitter thread")
+register_env("MXTPU_TELEMETRY_INTERVAL", float, 10.0,
+             "seconds between TelemetryEmitter snapshot flushes")
+register_env("MXTPU_TELEMETRY_MAX_MB", float, 64.0,
+             "rotate the JSONL telemetry file to <file>.1 past this "
+             "size; 0 disables rotation")
+register_env("MXTPU_STATUS_INTERVAL", float, 30.0,
+             "seconds between tools/launch.py aggregated cluster "
+             "status lines (built from per-worker heartbeat "
+             "telemetry snapshots); 0 disables")
+
 # Data-pipeline resilience (io/, gluon/data/; docs/data_pipeline.md).
 register_env("MXTPU_DATA_TIMEOUT", float, 600.0,
              "wall-clock deadline (s) on input-pipeline queue waits; "

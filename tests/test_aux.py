@@ -25,11 +25,17 @@ def test_profiler_collects_and_dumps():
         assert out == path
         with open(path) as f:
             trace = json.load(f)
-        names = [e["name"] for e in trace["traceEvents"]]
+        # the dump also carries chrome-tracing metadata ('M') and
+        # telemetry counter ('C') events, which have no duration —
+        # only complete ('X') events do (docs/observability.md)
+        ops = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+        names = [e["name"] for e in ops]
         assert len(names) >= 2
-        assert all(e["dur"] >= 0 for e in trace["traceEvents"])
+        assert all(e["dur"] >= 0 for e in ops)
         assert any("sum" in n or "mul" in n or "plus" in n
                    for n in names), names
+        assert any(e["ph"] == "M" and e["name"] == "process_name"
+                   for e in trace["traceEvents"])
 
 
 def test_monitor_observes_ops():

@@ -36,6 +36,7 @@ Launch modes:
 no parameter servers in the collective design.
 """
 import argparse
+import json
 import os
 import shlex
 import shutil
@@ -157,7 +158,133 @@ def _hb_path(hb_dir, attempt, rank):
     return os.path.join(hb_dir, f"hb-{attempt}-{rank}")
 
 
-def _run_once(spawners, hb_files=None, hb_timeout=0):
+# ---------------------------------------------------------------------------
+# telemetry aggregation (docs/observability.md)
+#
+# Workers append their current metric snapshot as a second JSON line
+# of the heartbeat file (resilience._beat + telemetry.heartbeat_payload),
+# so the launcher can aggregate ranks over the channel it already
+# monitors — no extra socket, no extra files.
+# ---------------------------------------------------------------------------
+
+# counters worth surfacing in the one-line status (error/recovery
+# signals an operator watches a hung or degrading job for)
+_ERROR_COUNTERS = ("retry_attempts_total", "collective_aborts_total",
+                   "data_quarantined_records_total",
+                   "dataloader_worker_restarts_total",
+                   "sentinel_bad_steps_total",
+                   "sentinel_skipped_steps_total",
+                   "sentinel_divergences_total", "rollbacks_total",
+                   "checkpoint_fallbacks_total",
+                   "loss_scale_backoffs_total")
+
+
+def _read_heartbeat(path):
+    """Parse one worker heartbeat file -> (beat_ts, snapshot|None).
+
+    Line 1 is the bare timestamp (unchanged contract: mtime monitors
+    and old parsers keep working); the last line, when it is a JSON
+    object, is the worker's telemetry snapshot.  Any malformed
+    content degrades to (None, None)/partial — the monitor must never
+    crash on a torn read."""
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return None, None
+    ts = None
+    if lines:
+        try:
+            ts = float(lines[0])
+        except ValueError:
+            pass
+    snap = None
+    if len(lines) > 1 and lines[-1].lstrip().startswith("{"):
+        try:
+            snap = json.loads(lines[-1])
+        except ValueError:
+            pass
+    return ts, snap
+
+
+def _collect_snapshots(hb_files):
+    """rank -> snapshot for every heartbeat file carrying one."""
+    snaps = {}
+    for rank, path in (hb_files or {}).items():
+        _, snap = _read_heartbeat(path)
+        if snap is not None:
+            snaps[rank] = snap
+    return snaps
+
+
+def _aggregate_telemetry(snaps):
+    """Combine per-rank snapshots: counters sum across ranks,
+    throughput sums, per-rank step counts identify the straggler
+    (the rank whose step counter trails the fleet)."""
+    agg = {"ranks": sorted(snaps), "counters": {}, "throughput": 0.0,
+           "steps": {}, "straggler": None}
+    for rank, snap in snaps.items():
+        for name, v in (snap.get("counters") or {}).items():
+            agg["counters"][name] = agg["counters"].get(name, 0) + v
+        gauges = snap.get("gauges") or {}
+        agg["throughput"] += gauges.get("throughput_samples_per_sec",
+                                        0.0)
+        agg["steps"][rank] = (snap.get("counters") or {}).get(
+            "train_steps_total", 0)
+    if len(agg["steps"]) > 1:
+        lo = min(agg["steps"], key=agg["steps"].get)
+        hi = max(agg["steps"].values())
+        if agg["steps"][lo] < hi:
+            agg["straggler"] = (lo, agg["steps"][lo], hi)
+    return agg
+
+
+def _format_status(agg):
+    """One cluster status line from an aggregate."""
+    steps = sum(agg["steps"].values())
+    parts = [f"{len(agg['ranks'])} rank(s)", f"steps={steps}"]
+    if agg["throughput"] > 0:
+        parts.append(f"{agg['throughput']:.1f} samples/s")
+    errs = [f"{n}={agg['counters'][n]}" for n in _ERROR_COUNTERS
+            if agg["counters"].get(n)]
+    if errs:
+        parts.append("errors: " + " ".join(errs))
+    if agg["straggler"] is not None:
+        rank, at, hi = agg["straggler"]
+        parts.append(f"straggler: rank {rank} at step {at}/{hi}")
+    return "launch.py: status: " + " | ".join(parts)
+
+
+def _format_report(snaps):
+    """Final multi-line run report from the last snapshots."""
+    if not snaps:
+        return ("launch.py: run report: no worker telemetry "
+                "(MXTPU_TELEMETRY=0, or the workers never joined "
+                "dist.init)")
+    agg = _aggregate_telemetry(snaps)
+    lines = ["launch.py: ----- run report -----"]
+    for rank in agg["ranks"]:
+        gauges = snaps[rank].get("gauges") or {}
+        tp = gauges.get("throughput_samples_per_sec")
+        lines.append(
+            f"launch.py:   rank {rank}: steps="
+            f"{agg['steps'].get(rank, 0)}"
+            + (f" {tp:.1f} samples/s" if tp else ""))
+    nonzero = {n: v for n, v in sorted(agg["counters"].items()) if v}
+    if nonzero:
+        lines.append("launch.py:   counters (summed over ranks):")
+        for name, v in nonzero.items():
+            lines.append(f"launch.py:     {name} = {v}")
+    if agg["straggler"] is not None:
+        rank, at, hi = agg["straggler"]
+        lines.append(f"launch.py:   straggler: rank {rank} finished "
+                     f"at step {at} of {hi}")
+    lines.append("launch.py: -----------------------")
+    return "\n".join(lines)
+
+
+def _run_once(spawners, hb_files=None, hb_timeout=0,
+              status_interval=0):
     """Start every worker; first nonzero exit tears the job down (a
     crashing worker mid-collective leaves peers blocked forever — the
     reference's ps-lite scheduler dies the same way).
@@ -169,8 +296,15 @@ def _run_once(spawners, hb_files=None, hb_timeout=0):
     is killed, which turns the hang into an ordinary failure the
     --max-restarts loop already handles.  A worker that never created
     its file is not monitored (it may be a pre-dist warmup phase or a
-    command that does not call dist.init())."""
+    command that does not call dist.init()).
+
+    With status_interval > 0 the monitor additionally aggregates the
+    telemetry snapshots riding the heartbeat files into one periodic
+    cluster status line (throughput, stragglers, error counters) —
+    the operator's view of *where* a slow job is slow."""
     procs = []
+    next_status = time.time() + status_interval \
+        if status_interval > 0 and hb_files else None
     try:
         for spawn in spawners:
             procs.append(spawn())
@@ -181,6 +315,12 @@ def _run_once(spawners, hb_files=None, hb_timeout=0):
                               # just wait for the reap
         while pending and rc == 0:
             now = time.time()
+            if next_status is not None and now >= next_status:
+                next_status = now + status_interval
+                snaps = _collect_snapshots(hb_files)
+                if snaps:
+                    print(_format_status(_aggregate_telemetry(snaps)),
+                          file=sys.stderr)
             for r, p in list(pending.items()):
                 code = p.poll()
                 if code is None:
@@ -250,6 +390,14 @@ def main():
     ap.add_argument("--heartbeat-interval", type=float,
                     default=_env_float("MXTPU_HEARTBEAT_INTERVAL", 2.0),
                     help="seconds between worker heartbeat refreshes")
+    ap.add_argument("--status-interval", type=float,
+                    default=_env_float("MXTPU_STATUS_INTERVAL", 30.0),
+                    help="local mode: seconds between aggregated "
+                    "cluster status lines built from the telemetry "
+                    "snapshots riding the worker heartbeat files "
+                    "(throughput, stragglers, error counters); 0 "
+                    "disables; a final run report always prints on "
+                    "exit when telemetry is available")
     ap.add_argument("--data-timeout", type=float, default=None,
                     help="export MXTPU_DATA_TIMEOUT to every worker: "
                     "input-pipeline queue waits past this many "
@@ -384,9 +532,10 @@ def main():
                 for r in range(args.num_workers)}
 
     try:
+        last_files = hb_files(0)
         coord = coord_for(0)
-        rc = _run_once(make_spawners(coord, 0), hb_files(0),
-                       args.heartbeat_timeout)
+        rc = _run_once(make_spawners(coord, 0), last_files,
+                       args.heartbeat_timeout, args.status_interval)
         for attempt in range(1, args.max_restarts + 1):
             if rc == 0:
                 break
@@ -403,8 +552,15 @@ def main():
                       "from their last checkpoint (params + optimizer "
                       ".states + input-pipeline .data companions)",
                       file=sys.stderr)
+            last_files = hb_files(attempt)
             rc = _run_once(make_spawners(coord_for(attempt), attempt),
-                           hb_files(attempt), args.heartbeat_timeout)
+                           last_files, args.heartbeat_timeout,
+                           args.status_interval)
+        # final run report from the exited workers' last snapshots
+        # (the heartbeat files persist until the cleanup below)
+        if last_files:
+            print(_format_report(_collect_snapshots(last_files)),
+                  file=sys.stderr)
         return rc
     finally:
         if hb_dir is not None:
