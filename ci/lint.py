@@ -90,6 +90,22 @@ SPAN_TIMING_MODULES = (
 # registry, so `snapshot()` output is always documented.
 METRIC_FACTORIES = {"counter", "gauge", "histogram", "span"}
 
+# The symbolic-IR graph is owned by the pass pipeline: outside
+# incubator_mxnet_tpu/graph/ and /symbol/, code must treat `_Node`
+# DAGs as read-only and rewrite them through the PassManager
+# (docs/graph_passes.md).  Direct structural mutation — constructing
+# or importing `_Node`, assigning `.op`/`.inputs`, list-mutating
+# `.inputs`, or writing `.attrs[...]`/`.params[...]` — is flagged;
+# a deliberate exception carries `# graph-ok: <why>` on the line.
+GRAPH_MUTATION_DIRS = (
+    "incubator_mxnet_tpu/graph/",
+    "incubator_mxnet_tpu/symbol/",
+)
+GRAPH_NODE_ATTRS = {"op", "inputs"}
+GRAPH_NODE_DICT_ATTRS = {"inputs", "attrs", "params"}
+GRAPH_LIST_MUTATORS = {"append", "extend", "insert", "remove", "pop",
+                       "clear", "reverse", "sort"}
+
 
 def _is_binary_write_open(node):
     """True for ``open(..., "wb"/"wb+"/...)`` calls."""
@@ -147,6 +163,69 @@ def _hot_sync_problems(path, tree, lines):
     return problems
 
 
+def _graph_mutation_problems(path, tree, lines):
+    """Flag direct `_Node` graph mutation outside the pass pipeline
+    (GRAPH_MUTATION_DIRS).  Lines annotated `# graph-ok: <why>` are
+    exempt; `self.<attr>` writes are a class's own state, not a graph
+    rewrite, and are never flagged."""
+    problems = []
+
+    def _ok(node):
+        line = lines[node.lineno - 1] \
+            if node.lineno - 1 < len(lines) else ""
+        return "graph-ok" in line
+
+    def _rooted_self(node):
+        while isinstance(node, ast.Attribute):
+            node = node.value
+        return isinstance(node, ast.Name) and node.id == "self"
+
+    def _flag(node, what):
+        problems.append(
+            f"{path}:{node.lineno}: {what} — the symbolic graph is "
+            "owned by the pass pipeline; rewrite through a "
+            "PassManager pass in incubator_mxnet_tpu/graph/ "
+            "(docs/graph_passes.md) or annotate the line with "
+            "'# graph-ok: <why>'")
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and node.id == "_Node" \
+                and not _ok(node):
+            _flag(node, "direct _Node use outside graph//symbol/")
+        if isinstance(node, ast.ImportFrom) \
+                and any(a.name == "_Node" for a in node.names) \
+                and not _ok(node):
+            _flag(node, "_Node import outside graph//symbol/")
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AugAssign):
+            targets = [node.target]
+        for t in targets:
+            if isinstance(t, ast.Attribute) \
+                    and t.attr in GRAPH_NODE_ATTRS \
+                    and not _rooted_self(t.value) and not _ok(t):
+                _flag(t, f"assignment to graph-node .{t.attr}")
+            if isinstance(t, ast.Subscript) \
+                    and isinstance(t.value, ast.Attribute) \
+                    and t.value.attr in GRAPH_NODE_DICT_ATTRS \
+                    and not _rooted_self(t.value.value) \
+                    and not _ok(t):
+                _flag(t, f"item write into graph-node "
+                         f".{t.value.attr}[...]")
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in GRAPH_LIST_MUTATORS | \
+                {"update", "setdefault"} \
+                and isinstance(node.func.value, ast.Attribute) \
+                and node.func.value.attr in GRAPH_NODE_DICT_ATTRS \
+                and not _rooted_self(node.func.value.value) \
+                and not _ok(node):
+            _flag(node, f"mutating call .{node.func.value.attr}."
+                        f"{node.func.attr}(...) on a graph node")
+    return problems
+
+
 def _imported_names(tree):
     """name -> lineno for every import binding."""
     out = {}
@@ -198,6 +277,10 @@ def check_file(path):
     if any(posix.endswith(m) for m in HOT_SYNC_FILES):
         problems.extend(
             _hot_sync_problems(path, tree, src.splitlines()))
+    if "incubator_mxnet_tpu" in posix and \
+            not any(d in posix for d in GRAPH_MUTATION_DIRS):
+        problems.extend(
+            _graph_mutation_problems(path, tree, src.splitlines()))
     if any(posix.endswith(m) for m in SPAN_TIMING_MODULES):
         lines = src.splitlines()
         for node in ast.walk(tree):

@@ -33,6 +33,7 @@ from . import symbol
 from . import symbol as sym
 from .symbol import Symbol
 from .executor import Executor
+from . import graph
 from . import io
 from . import initializer
 from .initializer import init

@@ -77,7 +77,13 @@ def build_graph_fn(symbol, placements=None, default_device=None,
             if op.needs_mode:
                 params["_training"] = is_train
             if op.needs_rng:
-                params["_rng"] = jax.random.fold_in(rng, rng_counter)
+                # optimized graphs pin each rng node's fold index at
+                # its pre-optimization position (__rng_index__, see
+                # graph.passes.stamp_rng_indices) so rewrites that
+                # remove neighbours never shift the key stream
+                idx = node.attrs.get("__rng_index__")
+                fold = int(idx) if idx is not None else rng_counter
+                params["_rng"] = jax.random.fold_in(rng, fold)
                 rng_counter += 1
             outs = op.fn(*ins, **params)
             outs_list = list(outs) if isinstance(outs, (tuple, list)) \
@@ -187,8 +193,21 @@ class Executor:
             else:
                 placements = None       # degenerate: single device
 
+        # graph-optimization pass pipeline (graph/, ROADMAP item 4):
+        # every non-placed bind routes the traced graph through the
+        # PassManager under MXTPU_GRAPH_OPT before compilation.
+        # Placed (group2ctx) graphs keep their original nodes — the
+        # placement map is keyed on node identity.  self._symbol
+        # stays the ORIGINAL symbol: listings, shape inference,
+        # reshape and the monitor tap all see the user's graph.
+        self.graph_report = None
+        run_symbol = symbol
+        if not self._placed:
+            from .graph.passes import optimize_symbol
+            run_symbol, self.graph_report = optimize_symbol(symbol)
         self._run = build_graph_fn(
-            symbol, placements=placements if self._placed else None,
+            run_symbol,
+            placements=placements if self._placed else None,
             default_device=self._ctx.jax_device if self._placed
             else None)
         self._placements = placements if self._placed else None

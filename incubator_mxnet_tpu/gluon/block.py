@@ -18,7 +18,6 @@ import threading
 import jax
 
 from .. import autograd, random_state
-from ..autograd import TapeNode
 from ..ndarray.ndarray import NDArray
 from ..symbol.symbol import Symbol
 from .parameter import (DeferredInitializationError,
@@ -171,21 +170,31 @@ class Block:
 
 class HybridBlock(Block):
     """Block compilable into one XLA executable
-    (ref: block.py HybridBlock:306)."""
+    (ref: block.py HybridBlock:306).
+
+    ``hybridize()`` swaps ``__call__`` onto a
+    :class:`~..graph.cached_op.CachedOp`: the forward is traced once
+    per (input shapes/dtypes, static args, train-flag) signature —
+    through the graph-optimization pass pipeline when the block is
+    symbol-traceable (``MXTPU_GRAPH_OPT`` >= 1), via ``jax.jit`` over
+    the eager forward otherwise — and replayed as a compiled callable
+    on every subsequent call.
+    """
 
     def __init__(self, prefix=None, params=None):
         super().__init__(prefix, params)
         self._active = False
-        self._cached_fn = None
-        self._param_order = None
+        self._cached_op = None
+        self._cache_fallback = False
 
     def hybridize(self, active=True):
         self._active = active
-        self._cached_fn = None
+        self._cached_op = None
+        self._cache_fallback = False
         super().hybridize(active)
 
     def cast(self, dtype):
-        self._cached_fn = None
+        self._cached_op = None
         super().cast(dtype)
 
     def infer_shape(self, *args):
@@ -292,110 +301,50 @@ class HybridBlock(Block):
         raise NotImplementedError
 
     # ------------------------------------------------------------ cached
-    def _build_cache(self):
-        """Create the jitted callable (ref: block.py _build_cache:365)."""
-        params = self.collect_params()
-        # stable ordering for the pytree
-        names = sorted(params.keys())
-        param_objs = [params[n] for n in names]
-        trainable_idx = [i for i, p in enumerate(param_objs)
-                         if p.grad_req != "null"]
-        state_idx = [i for i, p in enumerate(param_objs)
-                     if p.grad_req == "null"]
-        block = self
+    def _trace_symbol(self, template):
+        """Trace this block into a Symbol graph for CachedOp's
+        graph-optimized replay path; returns ``(symbol,
+        input_names)``.  Tensor argument slots become Variables,
+        canonicalized static args pass through to hybrid_forward
+        verbatim."""
+        from .. import symbol as sym_mod
+        names = []
 
-        def run(param_vals, input_vals, rng, training):
-            saved = [(p, p._data._data) for p in param_objs]
-            prev_rec = autograd.set_recording(False)
-            prev_train = autograd.set_training(training)
-            try:
-                for p, v in zip(param_objs, param_vals):
-                    p._data._data = v
-                with random_state.key_provider(rng):
-                    outs = block.forward(
-                        *[NDArray(v) for v in input_vals])
-                out_list = outs if isinstance(outs, (list, tuple)) \
-                    else [outs]
-                out_vals = [o._data for o in out_list]
-                state_vals = [param_objs[i]._data._data
-                              for i in state_idx]
-            finally:
-                for (p, v) in saved:
-                    p._data._data = v
-                autograd.set_recording(prev_rec)
-                autograd.set_training(prev_train)
-            return out_vals, state_vals
+        def make_tensor(i):
+            nm = f"data{i}"
+            names.append(nm)
+            return sym_mod.Variable(nm)
 
-        def fwd(param_vals, input_vals, rng, training):
-            return run(list(param_vals), list(input_vals), rng, training)
-
-        jitted = jax.jit(fwd, static_argnums=(3,))
-        return param_objs, trainable_idx, state_idx, jitted
+        out = self._to_symbol(*template.flat_args(make_tensor))
+        if isinstance(out, (list, tuple)):
+            out = sym_mod.Group(list(out))
+        return out, names
 
     def _call_cached(self, *args):
-        if self._cached_fn is None:
+        if self._cached_op is None:
             # settle deferred shapes: one eager forward lets each layer
             # infer its own param shapes from its actual input (the
             # reference's deferred-init pass, ref: block.py
-            # _deferred_infer_shape); then build the cache
+            # _deferred_infer_shape); then build the replay cache
             if any(p._deferred_init is not None
                    for _, p in self.collect_params().items()):
                 with autograd.pause():
                     self.forward(*args)
-            self._cached_fn = self._build_cache()
-        param_objs, trainable_idx, state_idx, jitted = self._cached_fn
-        param_vals = tuple(p.data()._data for p in param_objs)
-        input_nds = [a for a in args if isinstance(a, NDArray)]
-        input_vals = tuple(a._data for a in input_nds)
-        rng = random_state.next_key()
-        training = autograd.is_training()
-        recording = autograd.is_recording()
-
-        if recording:
-            t_idx = trainable_idx
-
-            def f(tvals, ivals):
-                pvals = list(param_vals)
-                for i, v in zip(t_idx, tvals):
-                    pvals[i] = v
-                return jitted(tuple(pvals), ivals, rng, training)
-
-            (out_vals, state_vals), vjp_fn = jax.vjp(
-                f, tuple(param_vals[i] for i in t_idx), input_vals)
-        else:
-            out_vals, state_vals = jitted(param_vals, input_vals, rng,
-                                          training)
-
-        if training:
-            for i, v in zip(state_idx, state_vals):
-                param_objs[i]._data._data = v
-
-        out_arrays = [NDArray(v) for v in out_vals]
-        if recording:
-            import numpy as np
-
-            def node_vjp(out_cts):
-                cts = list(out_cts) if isinstance(out_cts, tuple) \
-                    else [out_cts]
-                state_cts = [
-                    (np.zeros(v.shape, jax.dtypes.float0)
-                     if not jax.numpy.issubdtype(v.dtype,
-                                                 jax.numpy.floating)
-                     else jax.numpy.zeros(v.shape, v.dtype))
-                    for v in state_vals]
-                tcts, icts = vjp_fn((cts, state_cts))
-                return list(tcts) + list(icts)
-
-            node_inputs = [param_objs[i]._data for i in trainable_idx] \
-                + input_nds
-            avals = [(tuple(v.shape), v.dtype) for v in out_vals]
-            node = TapeNode(node_vjp, node_inputs, avals,
-                            f"CachedOp({self.name})")
-            for i, arr in enumerate(out_arrays):
-                arr._autograd = (node, i)
-        if len(out_arrays) == 1:
-            return out_arrays[0]
-        return out_arrays
+            from ..graph.cached_op import CachedOp
+            self._cached_op = CachedOp(self)
+        from ..graph.cached_op import UnsupportedSignatureError
+        try:
+            return self._cached_op(*args)
+        except UnsupportedSignatureError as exc:
+            # this CALL cannot be replay-cached; later calls with
+            # keyable arguments still hit the cache (warn only once)
+            if not self._cache_fallback:
+                self._cache_fallback = True
+                from ..utils.log import get_logger
+                get_logger().warning(
+                    "%s: arguments cannot key a replay cache (%s); "
+                    "this call runs eagerly", self.name, exc)
+            return self.forward(*args)
 
 
 class SymbolBlock(HybridBlock):
@@ -407,6 +356,7 @@ class SymbolBlock(HybridBlock):
         if isinstance(outputs, (list, tuple)):
             outputs = Group(outputs)
         self._symbol = outputs
+        self._graph_fn = None
         self._inputs = inputs if isinstance(inputs, (list, tuple)) \
             else [inputs]
         input_names = {i.name for i in self._inputs}
@@ -419,6 +369,15 @@ class SymbolBlock(HybridBlock):
                 if name in self._params.keys():
                     self._params[name].set_data(v)
 
+    def _trace_symbol(self, template):
+        """CachedOp graph path: the wrapped Symbol IS the trace."""
+        if not template.is_flat or len(template.tensor_nds) != \
+                len(self._inputs):
+            raise TypeError(
+                f"{self.name}: expected {len(self._inputs)} tensor "
+                "arguments for the wrapped symbol")
+        return self._symbol, [i.name for i in self._inputs]
+
     def forward(self, *args):
         from ..executor import build_graph_fn
         arg_vals = {}
@@ -426,9 +385,14 @@ class SymbolBlock(HybridBlock):
             arg_vals[i.name] = a._data
         for name, p in self.params.items():
             arg_vals[name] = p.data()._data
-        run = build_graph_fn(self._symbol)
-        outs, _ = run(arg_vals, {}, random_state.next_key(),
-                      autograd.is_training())
+        if self._graph_fn is None:
+            # built once, not per call: eager SymbolBlock forwards
+            # used to rebuild the whole evaluation closure every
+            # invocation
+            self._graph_fn = build_graph_fn(self._symbol)
+        outs, _ = self._graph_fn(arg_vals, {},
+                                 random_state.next_key(),
+                                 autograd.is_training())
         outs = [NDArray(o) for o in outs]
         return outs[0] if len(outs) == 1 else outs
 

@@ -1,0 +1,429 @@
+"""CachedOp: signature-keyed trace-once replay for gluon HybridBlocks.
+
+Role analog of the reference's ``CachedOp`` (ref:
+src/imperative/cached_op.cc GetForwardGraph:171, python/mxnet/gluon/
+block.py _build_cache:365).  ``HybridBlock.hybridize()`` routes
+``__call__`` here: the block's forward is traced ONCE per signature
+``(input shapes/dtypes, canonicalized static args, train-flag)`` and
+subsequent calls replay a compiled callable — no per-call Python walk
+of the layer tree, no retrace.
+
+Two trace backends, chosen per entry:
+
+- **graph** — when ``MXTPU_GRAPH_OPT`` >= 1 and the block is
+  symbol-traceable, the block is exported to a Symbol graph
+  (``HybridBlock._trace_symbol``), run through the graph-optimization
+  pass pipeline (``passes.optimize_symbol``), and compiled from the
+  *optimized* graph.  Blocks with rng-consuming ops (dropout) skip
+  this path so hybridized randomness keeps drawing the exact eager
+  key stream.
+- **jit** — fallback: ``jax.jit`` over the block's eager forward with
+  parameter values threaded functionally (the pre-CachedOp
+  ``_build_cache`` machinery), correct for every block.
+
+Static (non-tensor) call arguments are canonicalized into the
+signature with the ``_stable_pair`` hashing discipline — ``2``,
+``2.0`` and ``np.float32(2.0)`` are distinct only when their
+*type class* (int vs float) differs, never per-object — so a
+constant argument can never force a retrace per call.  Entries live
+in an LRU bounded by ``MXTPU_CACHEDOP_CAPACITY``.
+
+Backward replays are compiled too: each entry caches a jitted
+rematerializing vjp (the `_stable_pair` trade — recompute the
+forward inside backward, in exchange for once-per-signature
+compilation instead of per-step retracing).
+"""
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import autograd, random_state, telemetry
+from ..autograd import TapeNode
+from ..ndarray.ndarray import NDArray
+from ..utils.env import get_env
+from ..utils.log import get_logger
+from .passes import optimize_symbol
+
+__all__ = ["CachedOp", "UnsupportedSignatureError"]
+
+
+class UnsupportedSignatureError(TypeError):
+    """An argument cannot participate in a replay-cache signature."""
+
+
+def canonical_static(v):
+    """Stable hashable form of a non-tensor argument.
+
+    Numeric values collapse to their Python type class (``np.float32
+    (2.0)`` == ``2.0`` but != ``2``), so equal constants always hit
+    the same cache entry — the scalar analog of the ``_stable_pair``
+    param canonicalization.
+    """
+    if isinstance(v, (bool, np.bool_)):
+        return ("b", bool(v))
+    if isinstance(v, (int, np.integer)):
+        return ("i", int(v))
+    if isinstance(v, (float, np.floating)):
+        return ("f", float(v))
+    if v is None or isinstance(v, str):
+        return ("s", v)
+    if isinstance(v, np.ndarray) and v.ndim == 0:
+        return canonical_static(v.item())
+    raise UnsupportedSignatureError(
+        f"cannot key a replay cache on argument of type "
+        f"{type(v).__name__}")
+
+
+class _ArgsTemplate:
+    """Splits call args into tensor leaves + a static skeleton.
+
+    ``signature`` is the hashable cache key part; ``tensor_nds`` the
+    NDArray leaves in traversal order; :meth:`rebuild` re-creates the
+    original (possibly nested) argument structure around fresh tensor
+    values for the replay closure.
+    """
+
+    __slots__ = ("signature", "tensor_nds", "_spec")
+
+    def __init__(self, args):
+        self.tensor_nds = []
+        spec, sig = [], []
+        for a in args:
+            s, g = self._walk(a)
+            spec.append(s)
+            sig.append(g)
+        self._spec = tuple(spec)
+        self.signature = tuple(sig)
+
+    def _walk(self, a):
+        if isinstance(a, (np.ndarray, jnp.ndarray)) and \
+                getattr(a, "ndim", 0) != 0:
+            a = NDArray(jnp.asarray(a))
+        if isinstance(a, NDArray):
+            self.tensor_nds.append(a)
+            return (("T", len(self.tensor_nds) - 1),
+                    ("nd", tuple(a.shape), str(a._data.dtype)))
+        if isinstance(a, (list, tuple)):
+            walked = [self._walk(x) for x in a]
+            tag = "L" if isinstance(a, list) else "U"
+            return ((tag, tuple(w[0] for w in walked)),
+                    (tag, tuple(w[1] for w in walked)))
+        c = canonical_static(a)
+        return (("S", c), ("s", c))
+
+    @property
+    def is_flat(self):
+        """True when every top-level arg is a tensor or a static."""
+        return all(s[0] in ("T", "S") for s in self._spec)
+
+    def rebuild(self, tensor_vals):
+        """Reassemble args with NDArray-wrapped ``tensor_vals``."""
+        return _rebuild_args(self._spec, tensor_vals)
+
+    def flat_args(self, make_tensor):
+        """Build the flat argument list with ``make_tensor(i)`` filling
+        tensor slots (used by symbol tracing); statics pass through as
+        their canonical values."""
+        out, ti = [], 0
+        for tag, payload in self._spec:
+            if tag == "T":
+                out.append(make_tensor(ti))
+                ti += 1
+            elif tag == "S":
+                out.append(payload[1])
+            else:
+                raise UnsupportedSignatureError(
+                    "nested argument structures cannot be "
+                    "symbol-traced")
+        return out
+
+
+def _rebuild_args(spec, tensor_vals):
+    """Reassemble a call's argument structure around fresh tensor
+    values.  Module-level so replay closures capture only the static
+    ``spec`` — never the building call's input arrays (an LRU of 64
+    entries must not pin 64 full input batches in memory)."""
+    def _build(s):
+        tag, payload = s
+        if tag == "T":
+            return NDArray(tensor_vals[payload])
+        if tag == "S":
+            return payload[1]
+        seq = [_build(x) for x in payload]
+        return seq if tag == "L" else tuple(seq)
+    return [_build(s) for s in spec]
+
+
+class _Entry:
+    """One compiled signature: forward replay + cached backward."""
+
+    __slots__ = ("mode", "jfwd", "make_bwd", "_bwd", "aux_writeback")
+
+    def __init__(self, mode, jfwd, make_bwd, aux_writeback=None):
+        self.mode = mode
+        self.jfwd = jfwd
+        self.make_bwd = make_bwd
+        self._bwd = None
+        self.aux_writeback = aux_writeback
+
+    def bwd(self):
+        if self._bwd is None:
+            self._bwd = self.make_bwd()
+        return self._bwd
+
+
+class CachedOp:
+    """Signature-keyed trace-once replay cache for one HybridBlock."""
+
+    def __init__(self, block, capacity=None):
+        self._block = block
+        self._capacity = capacity if capacity is not None \
+            else get_env("MXTPU_CACHEDOP_CAPACITY")
+        self._entries = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self._trace_events = 0
+        # resolve the registry objects once — the hit path must not
+        # pay a registry lock per call
+        self._hits_ctr = telemetry.counter("cachedop_cache_hits_total")
+        self._misses_ctr = telemetry.counter(
+            "cachedop_cache_misses_total")
+        params = block.collect_params()
+        self._param_names = sorted(params.keys())
+        self._params = [params[n] for n in self._param_names]
+        self._param_by_name = dict(zip(self._param_names, self._params))
+        self._trainable_idx = [i for i, p in enumerate(self._params)
+                               if p.grad_req != "null"]
+        self._state_idx = [i for i, p in enumerate(self._params)
+                           if p.grad_req == "null"]
+
+    # ------------------------------------------------------------ stats
+    @property
+    def trace_count(self):
+        """Python trace executions (one per signature in steady state;
+        the proof behind ``cachedop_cache_misses_total``)."""
+        return self._trace_events
+
+    def stats(self):
+        return {"hits": self.hits, "misses": self.misses,
+                "traces": self._trace_events,
+                "entries": len(self._entries),
+                "modes": sorted({e.mode
+                                 for e in self._entries.values()})}
+
+    # ------------------------------------------------------------ call
+    def __call__(self, *args):
+        training = autograd.is_training()
+        recording = autograd.is_recording()
+        template = _ArgsTemplate(args)
+        key = (template.signature, bool(training))
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+        if entry is None:
+            self._misses_ctr.inc()
+            self.misses += 1
+            entry = self._build_entry(template, bool(training))
+            with self._lock:
+                entry = self._entries.setdefault(key, entry)
+                self._entries.move_to_end(key)
+                while self._capacity > 0 and \
+                        len(self._entries) > self._capacity:
+                    self._entries.popitem(last=False)
+        else:
+            self._hits_ctr.inc()
+            self.hits += 1
+        return self._execute(entry, template, bool(training), recording)
+
+    # ------------------------------------------------------------ build
+    def _build_entry(self, template, training):
+        level = int(get_env("MXTPU_GRAPH_OPT"))
+        if level >= 1 and template.is_flat:
+            try:
+                return self._build_graph_entry(template, training,
+                                               level)
+            except Exception as exc:   # block not symbol-traceable
+                get_logger().debug(
+                    "CachedOp(%s): graph trace unavailable (%s: %s); "
+                    "using jit replay", self._block.name,
+                    type(exc).__name__, exc)
+        return self._build_jit_entry(template, training)
+
+    def _merge_params(self, tvals, others):
+        pvals = [None] * len(self._params)
+        for i, v in zip(self._trainable_idx, tvals):
+            pvals[i] = v
+        for i, v in zip(self._state_idx, others):
+            pvals[i] = v
+        return pvals
+
+    # ---------------------------------------------------- graph backend
+    def _build_graph_entry(self, template, training, level):
+        from ..executor import build_graph_fn
+        from ..symbol.symbol import Symbol, _topo
+        sym, input_names = self._block._trace_symbol(template)
+        if not isinstance(sym, Symbol):
+            raise UnsupportedSignatureError(
+                "symbol trace returned non-Symbol")
+        for node in _topo(sym._heads):
+            if node.op is not None and node.op.needs_rng:
+                # rng nodes would draw from the graph key stream, not
+                # the eager one — keep randomness identical via jit
+                raise UnsupportedSignatureError(
+                    f"rng op '{node.op.name}' in traced graph")
+        clash = set(self._param_names) & set(input_names)
+        if clash:
+            raise UnsupportedSignatureError(
+                f"input names collide with parameters: {sorted(clash)}")
+        known = set(self._param_names) | set(input_names)
+        unknown = [n for n in sym.list_inputs() if n not in known]
+        if unknown:
+            raise UnsupportedSignatureError(
+                f"traced graph has unbound inputs {unknown}")
+        opt_sym, _report = optimize_symbol(sym, level=level)
+        run = build_graph_fn(opt_sym)
+        param_names = self._param_names
+        co = self
+
+        def fwd(param_vals, input_vals, rng):
+            co._trace_events += 1
+            arg_vals = dict(zip(param_names, param_vals))
+            arg_vals.update(zip(input_names, input_vals))
+            outs, aux_upd = run(arg_vals, {}, rng, training)
+            return list(outs), dict(aux_upd)
+
+        jfwd = jax.jit(fwd)
+
+        def make_bwd():
+            def bwd(tvals, others, input_vals, rng, out_cts):
+                def f(tv, iv):
+                    pvals = self._merge_params(tv, others)
+                    arg_vals = dict(zip(param_names, pvals))
+                    arg_vals.update(zip(input_names, iv))
+                    outs, _ = run(arg_vals, {}, rng, training)
+                    return tuple(outs)
+                _, vjp = jax.vjp(f, tuple(tvals), tuple(input_vals))
+                tcts, icts = vjp(tuple(out_cts))
+                return list(tcts), list(icts)
+            return _jit_with_fallback(bwd)
+
+        return _Entry("graph", jfwd, make_bwd,
+                      aux_writeback=self._write_aux)
+
+    def _write_aux(self, aux_upd):
+        for name, val in aux_upd.items():
+            p = self._param_by_name.get(name)
+            if p is not None:
+                p._data._data = val
+
+    # ------------------------------------------------------ jit backend
+    def _build_jit_entry(self, template, training):
+        block = self._block
+        param_objs = self._params
+        state_idx = self._state_idx
+        spec = template._spec          # structure only, no arrays
+        co = self
+
+        def run(param_vals, input_vals, rng):
+            saved = [(p, p._data._data) for p in param_objs]
+            prev_rec = autograd.set_recording(False)
+            prev_train = autograd.set_training(training)
+            try:
+                for p, v in zip(param_objs, param_vals):
+                    p._data._data = v
+                with random_state.key_provider(rng):
+                    outs = block.forward(*_rebuild_args(spec,
+                                                        input_vals))
+                out_list = outs if isinstance(outs, (list, tuple)) \
+                    else [outs]
+                out_vals = [o._data for o in out_list]
+                state_vals = [param_objs[i]._data._data
+                              for i in state_idx]
+            finally:
+                for (p, v) in saved:
+                    p._data._data = v
+                autograd.set_recording(prev_rec)
+                autograd.set_training(prev_train)
+            return out_vals, state_vals
+
+        def fwd(param_vals, input_vals, rng):
+            co._trace_events += 1
+            return run(list(param_vals), list(input_vals), rng)
+
+        jfwd = jax.jit(fwd)
+
+        def make_bwd():
+            def bwd(tvals, others, input_vals, rng, out_cts):
+                def f(tv, iv):
+                    pvals = self._merge_params(tv, others)
+                    out_vals, _ = run(pvals, list(iv), rng)
+                    return tuple(out_vals)
+                _, vjp = jax.vjp(f, tuple(tvals), tuple(input_vals))
+                tcts, icts = vjp(tuple(out_cts))
+                return list(tcts), list(icts)
+            return _jit_with_fallback(bwd)
+
+        return _Entry("jit", jfwd, make_bwd)
+
+    # ---------------------------------------------------------- execute
+    def _execute(self, entry, template, training, recording):
+        param_vals = tuple(p.data()._data for p in self._params)
+        input_nds = template.tensor_nds
+        input_vals = tuple(a._data for a in input_nds)
+        rng = random_state.next_key()
+
+        out_vals, state = entry.jfwd(param_vals, input_vals, rng)
+        if training:
+            if entry.mode == "graph":
+                entry.aux_writeback(state)
+            else:
+                for i, v in zip(self._state_idx, state):
+                    self._params[i]._data._data = v
+
+        out_arrays = [NDArray(v) for v in out_vals]
+        if recording:
+            t_idx = self._trainable_idx
+            tvals = tuple(param_vals[i] for i in t_idx)
+            others = tuple(param_vals[i] for i in self._state_idx)
+
+            def node_vjp(out_cts):
+                cts = list(out_cts) if isinstance(out_cts, tuple) \
+                    else [out_cts]
+                tcts, icts = entry.bwd()(tvals, others, input_vals,
+                                         rng, tuple(cts))
+                return list(tcts) + list(icts)
+
+            node_inputs = [self._params[i]._data for i in t_idx] \
+                + list(input_nds)
+            avals = [(tuple(v.shape), v.dtype) for v in out_vals]
+            node = TapeNode(node_vjp, node_inputs, avals,
+                            f"CachedOp({self._block.name})")
+            for i, arr in enumerate(out_arrays):
+                arr._autograd = (node, i)
+        if len(out_arrays) == 1:
+            return out_arrays[0]
+        return out_arrays
+
+
+def _jit_with_fallback(bwd):
+    """jit the backward; fall back to the uncompiled closure once if
+    compilation rejects the cotangent structure (float0 cotangents of
+    integer outputs are not valid jit inputs)."""
+    jitted = jax.jit(bwd)
+    state = {"fn": jitted}
+
+    def call(*a):
+        try:
+            return state["fn"](*a)
+        except (TypeError, ValueError):
+            if state["fn"] is not bwd:
+                state["fn"] = bwd
+                return bwd(*a)
+            raise
+    return call

@@ -63,7 +63,12 @@ class SymbolTrainStep:
         self._guard_select = self._guarded if guard_select is None \
             else bool(guard_select)
         self.last_finite = None
-        self._run = build_graph_fn(symbol)
+        # the mesh step compiles the same optimized graph the
+        # single-device Executor does (MXTPU_GRAPH_OPT; rng fold
+        # indices are pinned, so the dropout stream is unchanged)
+        from ..graph.passes import optimize_symbol
+        run_symbol, self.graph_report = optimize_symbol(symbol)
+        self._run = build_graph_fn(run_symbol)
         self._param_names = tuple(sorted(param_vals))
         self._input_names = tuple(input_names)
         self._batch_axis = batch_axis

@@ -76,6 +76,12 @@ class Predictor:
         self._inputs[name] = value if isinstance(value, NDArray) \
             else nd_mod.array(np.asarray(value))
 
+    @property
+    def graph_report(self):
+        """Graph-optimization report of the serving bind (per-pass
+        node deltas; docs/graph_passes.md)."""
+        return self._exec.graph_report
+
     def forward(self, **inputs):
         """MXPredForward analog; inputs may also be passed directly."""
         for k, v in inputs.items():

@@ -379,6 +379,20 @@ class Symbol:
         with open(fname, "w") as f:
             f.write(self.tojson())
 
+    # -------------------------------------------------------------- opt
+    def optimize(self, level=None, pass_names=None):
+        """Run the graph-optimization pass pipeline over this symbol
+        (docs/graph_passes.md); returns ``(optimized_symbol,
+        report)``.  ``bind``/``simple_bind`` already route through
+        the pipeline under ``MXTPU_GRAPH_OPT``; call this directly to
+        inspect per-pass node deltas or force a level.  The result
+        may contain bind-internal nodes (folded constants, fused
+        elementwise regions) that do not serialize via ``tojson``.
+        """
+        from ..graph.passes import optimize_symbol
+        return optimize_symbol(self, level=level,
+                               pass_names=pass_names)
+
     # -------------------------------------------------------------- bind
     def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
                     group2ctx=None, **kwargs):

@@ -72,6 +72,18 @@ register_env("MXTPU_CPU_WORKER_NTHREADS", int, 4,
              "host worker threads for data pipeline")
 register_env("MXTPU_SEED", int, 0, "global RNG seed at import")
 
+# Graph optimization (graph/; docs/graph_passes.md).
+register_env("MXTPU_GRAPH_OPT", int, 1,
+             "graph-optimization level for Executor.bind and CachedOp "
+             "symbol tracing: 0 disables the pass pipeline, 1 "
+             "(default) runs the safe structural passes (identity/"
+             "transpose-pair elimination, constant folding, CSE, "
+             "dead-node pruning), 2 adds elementwise-chain "
+             "pre-fusion")
+register_env("MXTPU_CACHEDOP_CAPACITY", int, 64,
+             "max compiled signatures a hybridized block's CachedOp "
+             "retains (LRU eviction); <=0 disables the bound")
+
 # Resilience layer (resilience.py; docs/resilience.md).
 register_env("MXTPU_COLLECTIVE_TIMEOUT", float, 600.0,
              "wall-clock deadline (s) for dist collectives; a hung "
