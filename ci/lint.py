@@ -41,6 +41,9 @@ CKPT_MODULES = (
 DATA_QUEUE_DIRS = (
     "incubator_mxnet_tpu/io/",
     "incubator_mxnet_tpu/gluon/data/",
+    # serving request queues: a wedged submitter must never hang the
+    # scheduler loop
+    "incubator_mxnet_tpu/serving/",
 )
 
 # Guarded training hot paths (step sentinel,
@@ -53,10 +56,18 @@ DATA_QUEUE_DIRS = (
 HOT_SYNC_FILES = (
     "incubator_mxnet_tpu/gluon/trainer.py",
     "incubator_mxnet_tpu/optimizer.py",
+    # serving hot paths: the continuous-batching loop budgets ONE
+    # device->host read per iteration (the token read, annotated
+    # sync-ok); anything else would serialize the decode stream
+    "incubator_mxnet_tpu/serving/engine.py",
+    "incubator_mxnet_tpu/serving/scheduler.py",
 )
 HOT_SYNC_FUNCS = {"step", "update", "__call__", "begin_step",
                   "guarded_step_begin", "read_window_bad",
-                  "accumulate_window", "all_finite"}
+                  "accumulate_window", "all_finite",
+                  # serving scheduler loop + decode step
+                  "_admit", "_grow", "_decode_once", "_append_token",
+                  "_retire", "_preempt", "_fail", "stream", "run"}
 # attrs that always sync, and ones that sync only for specific roots
 SYNC_ATTRS = {"item", "asscalar", "asnumpy"}
 SYNC_ROOT_ATTRS = {("np", "asarray"), ("numpy", "asarray"),

@@ -57,6 +57,7 @@ from . import module as mod
 from .module import Module
 from .io import DataBatch, DataDesc, DataIter, NDArrayIter
 from . import gluon
+from . import serving
 from . import rnn
 from . import recordio
 from . import image
@@ -77,4 +78,4 @@ __all__ = ["nd", "ndarray", "autograd", "Context", "cpu", "tpu", "gpu",
            "module", "mod", "Module", "gluon", "DataBatch", "DataDesc",
            "DataIter", "NDArrayIter", "load_checkpoint",
            "save_checkpoint", "list_env", "resilience", "telemetry",
-           "__version__"]
+           "serving", "__version__"]

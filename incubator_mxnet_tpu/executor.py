@@ -27,6 +27,9 @@ from .symbol.symbol import _topo
 
 __all__ = ["Executor", "build_graph_fn"]
 
+# once-per-process notice when a partial last batch is padded
+_PARTIAL_WARNED = False
+
 
 def build_graph_fn(symbol, placements=None, default_device=None,
                    tap=None):
@@ -217,6 +220,7 @@ class Executor:
         self._jit_fwd_bwd = {}
         self._outputs = None
         self._last_rng = None
+        self._batch_row_outputs = {}    # batch -> pad/slice is exact
 
     # ------------------------------------------------------------- helpers
     @staticmethod
@@ -317,7 +321,18 @@ class Executor:
 
     def forward(self, is_train=False, **kwargs):
         """Run forward; returns output NDArrays
-        (ref: graph_executor.cc Forward:81)."""
+        (ref: graph_executor.cc Forward:81).
+
+        A PARTIAL LAST BATCH — an input whose leading dimension is
+        smaller than the bound batch size — is padded up to the
+        bound shape and the outputs are sliced back, so the one
+        compiled executable serves every tail batch instead of
+        failing on baked shapes (or recompiling per size).  Padding
+        only engages when EVERY output carries the batch as its
+        leading dimension — a graph that reduces over the batch axis
+        (a mean loss head) would silently average the padded rows,
+        so such graphs keep the exact-shape behavior."""
+        n_partial = self._pad_partial(kwargs)
         self._set_inputs(kwargs)
         rng = random_state.next_key()
         self._last_rng = rng
@@ -332,7 +347,82 @@ class Executor:
         for name, val in aux_upd.items():
             self.aux_dict[name]._data = val
         self._outputs = self._wrap_outputs(outs)
+        if n_partial is not None:
+            batch = self._partial_bound_batch
+            self._outputs = [
+                NDArray(o._data[:n_partial], o._ctx)
+                if o.shape and o.shape[0] == batch else o
+                for o in self._outputs]
         return self._outputs
+
+    _partial_bound_batch = None
+
+    def _outputs_are_batch_rowed(self, batch):
+        """True iff every graph output's leading dim is ``batch`` —
+        the precondition for pad/slice to be exact (a padded row
+        must never fold into a real row's value, which a
+        batch-reducing output would)."""
+        cached = self._batch_row_outputs.get(batch)
+        if cached is None:
+            try:
+                shapes = self.output_shapes
+            except Exception:
+                shapes = None
+            cached = bool(shapes) and all(
+                s and s[0] == batch for s in shapes)
+            self._batch_row_outputs[batch] = cached
+        return cached
+
+    def _pad_partial(self, kwargs):
+        """Pad partial-last-batch inputs to the bound batch size;
+        returns the true row count (or None when nothing padded)."""
+        n = None
+        bound_batch = None
+        partial = []
+        for k, v in kwargs.items():
+            bound = self.arg_dict.get(k)
+            if bound is None:
+                continue                # _set_inputs raises clearly
+            # shape probe without any device->host transfer; only a
+            # genuinely partial input is materialized for padding
+            vshape = tuple(v.shape) if hasattr(v, "shape") \
+                else np.asarray(v).shape
+            bshape = bound.shape
+            if vshape == bshape or len(vshape) != len(bshape) \
+                    or not bshape:
+                continue
+            if vshape[1:] == bshape[1:] and vshape[0] < bshape[0]:
+                if n is not None and n != vshape[0]:
+                    raise ValueError(
+                        "partial batch sizes disagree across "
+                        f"inputs ({n} vs {vshape[0]} for {k!r})")
+                n = vshape[0]
+                bound_batch = bshape[0]
+                partial.append(k)
+        if n is None or not self._outputs_are_batch_rowed(
+                bound_batch):
+            # batch-reducing (or shapeless) outputs: keep the exact
+            # old behavior — recompile at the true shape, or fail
+            # loudly on baked shapes — rather than silently folding
+            # padded rows into a reduction
+            return None
+        for k in partial:
+            v = kwargs[k]
+            arr = v.asnumpy() if isinstance(v, NDArray) \
+                else np.asarray(v)
+            pad = np.zeros(self.arg_dict[k].shape, arr.dtype)
+            pad[:n] = arr
+            kwargs[k] = pad
+        self._partial_bound_batch = bound_batch
+        global _PARTIAL_WARNED
+        if not _PARTIAL_WARNED:
+            from .utils.log import get_logger
+            get_logger().warning(
+                "partial batch of %d rows padded to the bound "
+                "batch %d (outputs sliced back; reported once)",
+                n, bound_batch)
+            _PARTIAL_WARNED = True
+        return n
 
     def _wrap_outputs(self, outs):
         ctxs = self._out_ctx or [self._ctx] * len(outs)

@@ -10,6 +10,7 @@ for sequence-parallel scale-out the attention core swaps for
 parallel.ring_attention (see parallel/ring_attention.py).
 """
 import math
+from collections import OrderedDict
 
 import numpy as np
 
@@ -19,6 +20,70 @@ from ..nn import Dense, Dropout, Embedding, LayerNorm
 
 __all__ = ["TransformerLM", "TransformerBlock", "CausalSelfAttention",
            "transformer_lm"]
+
+
+# --------------------------------------------------------------------------
+# decode math shared by the paged-KV serving builders (serving/engine.py).
+# Every formula mirrors _build_decode exactly so continuous batching
+# emits the same greedy tokens as generate(); the only new ingredient
+# is indirection through a block table.  Weights may be int8-quantized
+# (serving/quantize.py): a {"q", "s"} dict leaf dequantizes at use.
+# --------------------------------------------------------------------------
+
+
+def _q_mat(w):
+    """Dense matrix, dequantized if int8: ``q * s`` per out-channel.
+    XLA fuses the dequant into the consuming matmul's weight read."""
+    import jax.numpy as jnp
+    if isinstance(w, dict):
+        return w["q"].astype(jnp.float32) * w["s"][:, None]
+    return w
+
+
+def _q_rows(w, idx):
+    """Embedding-table gather; quantized tables dequantize only the
+    gathered rows (never the dense table) inside the step."""
+    import jax.numpy as jnp
+    if isinstance(w, dict):
+        return w["q"][idx].astype(jnp.float32) * w["s"][idx][..., None]
+    return w[idx]
+
+
+def _jln(x, gb):
+    """LayerNorm over the last axis — same epsilon/formula as the
+    ``ln`` closure in _build_decode."""
+    import jax.numpy as jnp
+    mu = jnp.mean(x, -1, keepdims=True)
+    var = jnp.mean((x - mu) ** 2, -1, keepdims=True)
+    return (x - mu) / jnp.sqrt(var + 1e-5) * gb[0] + gb[1]
+
+
+def _ffn_rows(lw, cf, x2d):
+    """Dense or MoE FFN on flattened (T, D) tokens — the same
+    routing code as training and _build_decode."""
+    import jax
+    if "moe" in lw:
+        from ...ops.moe import moe_ffn_fn
+        y, _ = moe_ffn_fn(x2d, *lw["moe"], capacity_factor=cf)
+        return y
+    return jax.nn.relu(x2d @ _q_mat(lw["up"][0]).T + lw["up"][1]) \
+        @ _q_mat(lw["down"][0]).T + lw["down"][1]
+
+
+def _rope_rows(x, pos, base=10000.0):
+    """RoPE for one token per batch row: x (B, H, Dh), pos (B,)
+    absolute positions.  The per-slot analog of
+    ``ops.matrix.rope_fn(..., offset=i)`` — identical angle formula,
+    so paged decode rotates exactly like generate()'s scan step."""
+    import jax.numpy as jnp
+    half = x.shape[-1] // 2
+    inv = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    ang = pos.astype(jnp.float32)[:, None] * inv[None, :]
+    cos = jnp.cos(ang)[:, None, :]
+    sin = jnp.sin(ang)[:, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
 
 # once-per-process notice when an explicit ulysses request falls back
 _ULYSSES_WARNED = False
@@ -349,7 +414,7 @@ class TransformerLM(Block):
         return [logits, aux] if self.moe_experts else logits
 
     # ------------------------------------------------------------ decode
-    _GEN_CACHE_MAX = 16   # compiled decode executables kept (FIFO)
+    _GEN_CACHE_MAX = 16   # compiled decode executables kept (LRU)
 
     def generate(self, tokens, max_new_tokens, temperature=0.0,
                  top_k=0, top_p=1.0, rng=None):
@@ -404,15 +469,19 @@ class TransformerLM(Block):
                int(top_k) if sampling else 0,
                float(top_p) if sampling else 1.0)
         cache = getattr(self, "_gen_cache", None)
-        if cache is None:
-            cache = self._gen_cache = {}
-        if key not in cache:
+        if not isinstance(cache, OrderedDict):
+            # true LRU, not FIFO: an alternating pair of hot
+            # signatures at capacity must not thrash recompiles
+            cache = self._gen_cache = OrderedDict(cache or {})
+        fn = cache.get(key)
+        if fn is None:
             if len(cache) >= self._GEN_CACHE_MAX:
-                cache.pop(next(iter(cache)))
-            cache[key] = jax.jit(self._build_decode(
+                cache.popitem(last=False)       # least recently used
+            fn = cache[key] = jax.jit(self._build_decode(
                 b, p, int(max_new_tokens), temperature > 0,
                 top_k=int(top_k), top_p=float(top_p)))
-        fn = cache[key]
+        else:
+            cache.move_to_end(key)              # refresh on hit
         if rng is None:
             rng = jax.random.PRNGKey(0)
         out = fn(wts, jnp.asarray(toks_np),
@@ -465,26 +534,13 @@ class TransformerLM(Block):
         window = self.attn_window
         from ...ops.matrix import rope_fn
 
-        def ln(x, gb):
-            mu = jnp.mean(x, -1, keepdims=True)
-            var = jnp.mean((x - mu) ** 2, -1, keepdims=True)
-            return (x - mu) / jnp.sqrt(var + 1e-5) * gb[0] + gb[1]
-
-        # capacity factors are STATIC per layer (compile-time), not
-        # part of the traced weights pytree
+        # LayerNorm / FFN math is the module-level _jln/_ffn_rows —
+        # one implementation shared with the paged serving builders,
+        # so generate() and the serving engine can never diverge.
+        # Capacity factors are STATIC per layer (compile-time), not
+        # part of the traced weights pytree.
         cfs = [blk.moe._cf if blk.moe_experts else None
                for blk in self.blocks]
-
-        def _ffn(lw, cf, x2d):
-            """Dense or MoE FFN on flattened (T, D) tokens — the
-            SAME routing code as training (ops/moe.py)."""
-            if "moe" in lw:
-                from ...ops.moe import moe_ffn_fn
-                y, _ = moe_ffn_fn(x2d, *lw["moe"],
-                                  capacity_factor=cf)
-                return y
-            return jax.nn.relu(x2d @ lw["up"][0].T + lw["up"][1]) \
-                @ lw["down"][0].T + lw["down"][1]
 
         def restrict(logits):
             """top-k / nucleus filtering on (B, V) logits."""
@@ -527,7 +583,7 @@ class TransformerLM(Block):
                 mask &= diff < window
             caches = []
             for lw, cf in zip(wts["layers"], cfs):
-                xa = ln(x, lw["ln1"])
+                xa = _jln(x, lw["ln1"])
                 qkv = xa @ lw["qkv"][0].T + lw["qkv"][1]
                 q = qkv[..., :d].reshape(b, p, h, dh)
                 k = qkv[..., d:d + kvd].reshape(b, p, kv, dh)
@@ -554,11 +610,11 @@ class TransformerLM(Block):
                 o = o.reshape(b, h, p, dh) \
                     .transpose(0, 2, 1, 3).reshape(b, p, d)
                 x = x + o @ lw["proj"][0].T + lw["proj"][1]
-                xm = ln(x, lw["ln2"])
-                x = x + _ffn(lw, cf, xm.reshape(b * p, d)) \
+                xm = _jln(x, lw["ln2"])
+                x = x + _ffn_rows(lw, cf, xm.reshape(b * p, d)) \
                     .reshape(b, p, d)
                 caches.append((kc, vc))
-            logits = ln(x[:, -1], wts["ln_f"]) @ wts["head"].T
+            logits = _jln(x[:, -1], wts["ln_f"]) @ wts["head"].T
             return caches, logits
 
         def decode(wts, prompt, temp, rng):
@@ -578,7 +634,7 @@ class TransformerLM(Block):
                 new_caches = []
                 for (lw, cf), (kc, vc) in zip(
                         zip(wts["layers"], cfs), caches):
-                    xa = ln(x, lw["ln1"])
+                    xa = _jln(x, lw["ln1"])
                     qkv = xa @ lw["qkv"][0].T + lw["qkv"][1]
                     q = qkv[..., :d]
                     k = qkv[..., d:d + kvd]
@@ -609,10 +665,10 @@ class TransformerLM(Block):
                         .reshape(b, h, dh)
                     x = x + o.reshape(b, d) @ lw["proj"][0].T \
                         + lw["proj"][1]
-                    xm = ln(x, lw["ln2"])
-                    x = x + _ffn(lw, cf, xm)
+                    xm = _jln(x, lw["ln2"])
+                    x = x + _ffn_rows(lw, cf, xm)
                     new_caches.append((kc, vc))
-                logits = ln(x, wts["ln_f"]) @ wts["head"].T
+                logits = _jln(x, wts["ln_f"]) @ wts["head"].T
                 nxt, rng = pick(logits, temp, rng)
                 toks = lax.dynamic_update_index_in_dim(
                     toks, nxt, i + 1, axis=1)
@@ -627,6 +683,195 @@ class TransformerLM(Block):
             return toks
 
         return decode
+
+    # ---------------------------------------------------- paged decode
+    # Block-table variants of prefill/step for the serving tier
+    # (serving/engine.py, docs/serving.md).  KV lives in fixed pools
+    # of shape (num_blocks, block_size, kv_heads, head_dim) per
+    # layer; a request's context is the ordered block-id row it owns.
+    # Scatter/gather by block id happens INSIDE the jitted function,
+    # so admission/retirement never changes the traced signature —
+    # one compiled step per (max_batch, max_blocks) forever.
+
+    def _check_paged(self):
+        if self.attn_window:
+            raise NotImplementedError(
+                "paged serving over sliding-window attention is not "
+                "implemented — serve attn_window=0 models, or decode "
+                "via generate()")
+        if self.moe_experts:
+            # top-2 routing sets expert capacity from the BATCH of
+            # tokens in flight: concurrent slots contend for
+            # capacity a sequential generate() call never sees, so
+            # served logits would depend on batch occupancy and the
+            # greedy-equivalence contract would silently break
+            raise NotImplementedError(
+                "paged serving of MoE models is not implemented — "
+                "shared expert capacity makes logits depend on "
+                "batchmates; decode MoE models via generate()")
+
+    def _build_paged_prefill(self, suffix_len, max_blocks,
+                             block_size):
+        """Suffix prefill over the block-table cache.
+
+        One traced signature per padded suffix length: embeds ``S``
+        suffix tokens at absolute positions ``n_past + i``, scatters
+        their K/V into the request's blocks, and attends over the
+        whole block-table context — ``n_past = 0`` is a full
+        prefill; ``n_past > 0`` resumes after a prefix-cache hit
+        without recomputing the shared blocks.  Rows past
+        ``true_len`` are padding: they scatter to the scratch block
+        (id 0) and their outputs are discarded.
+
+        Returns ``prefill(wts, kpools, vpools, table, n_past,
+        tokens, true_len) -> (kpools, vpools, next_token, logits)``
+        where ``next_token`` is the greedy argmax after the last
+        real suffix token.
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        self._check_paged()
+        d, h = self._d, self.n_heads
+        dh = d // h
+        kv = self.n_kv_heads
+        rep = h // kv
+        kvd = kv * dh
+        scale = math.sqrt(d)
+        use_rope = self._pos_kind == "rope"
+        max_len = self._max_len
+        from ...ops.matrix import rope_fn
+        S, MB, bs = int(suffix_len), int(max_blocks), int(block_size)
+        C = MB * bs
+        cfs = [blk.moe._cf if blk.moe_experts else None
+               for blk in self.blocks]
+
+        def prefill(wts, kpools, vpools, table, n_past, tokens,
+                    true_len):
+            x = _q_rows(wts["embed"], tokens) * scale       # (S, D)
+            pos = n_past + jnp.arange(S)
+            if not use_rope:
+                x = x + _q_rows(wts["pos"],
+                                jnp.minimum(pos, max_len - 1))
+            valid = jnp.arange(S) < true_len
+            wpos = jnp.where(valid, pos, 0)
+            blk = jnp.where(
+                valid, table[jnp.minimum(wpos // bs, MB - 1)], 0)
+            off = wpos % bs
+            keep = jnp.arange(C)[None, :] <= pos[:, None]   # (S, C)
+            new_k, new_v = [], []
+            for li, (lw, cf) in enumerate(zip(wts["layers"], cfs)):
+                xa = _jln(x, lw["ln1"])
+                qkvm = xa @ _q_mat(lw["qkv"][0]).T + lw["qkv"][1]
+                q = qkvm[:, :d].reshape(S, h, dh)
+                k = qkvm[:, d:d + kvd].reshape(S, kv, dh)
+                v = qkvm[:, d + kvd:].reshape(S, kv, dh)
+                if use_rope:
+                    q = rope_fn(q[None], offset=n_past)[0]
+                    k = rope_fn(k[None], offset=n_past)[0]
+                kp = kpools[li].at[blk, off].set(k)
+                vp = vpools[li].at[blk, off].set(v)
+                # gather the whole context back through the table:
+                # lane c of the flattened (C,) axis IS absolute
+                # position c, because the row is ordered by logical
+                # block index
+                kc = kp[table].reshape(C, kv, dh).transpose(1, 0, 2)
+                vc = vp[table].reshape(C, kv, dh).transpose(1, 0, 2)
+                qg = q.transpose(1, 0, 2).reshape(kv, rep, S, dh)
+                s = jnp.einsum("krsd,kcd->krsc", qg, kc) \
+                    / math.sqrt(dh)
+                att = jax.nn.softmax(
+                    jnp.where(keep[None, None], s, -1e9), axis=-1)
+                o = jnp.einsum("krsc,kcd->krsd", att, vc)
+                o = o.reshape(h, S, dh).transpose(1, 0, 2) \
+                    .reshape(S, d)
+                x = x + o @ _q_mat(lw["proj"][0]).T + lw["proj"][1]
+                xm = _jln(x, lw["ln2"])
+                x = x + _ffn_rows(lw, cf, xm)
+                new_k.append(kp)
+                new_v.append(vp)
+            xl = lax.dynamic_index_in_dim(x, true_len - 1, 0,
+                                          keepdims=False)
+            logits = _jln(xl, wts["ln_f"]) @ _q_mat(wts["head"]).T
+            nxt = jnp.argmax(logits).astype(jnp.int32)
+            return new_k, new_v, nxt, logits
+
+        return prefill
+
+    def _build_paged_step(self, max_batch, max_blocks, block_size):
+        """One continuous-batching decode step over the block pool.
+
+        Feeds every slot's newest token at its own position, scatters
+        the new K/V through each slot's block-table row, and attends
+        over the gathered context.  Inactive slots ride along with
+        ``n_past = 0`` and an all-scratch row — their writes land in
+        block 0 and their outputs are ignored by the host — so the
+        step needs NO liveness branch and admission/retirement reuse
+        the one compiled executable.
+
+        Returns ``step(wts, kpools, vpools, tables, n_past, tokens)
+        -> (kpools, vpools, next_tokens, logits)`` (greedy argmax
+        per slot).
+        """
+        import jax
+        import jax.numpy as jnp
+
+        self._check_paged()
+        d, h = self._d, self.n_heads
+        dh = d // h
+        kv = self.n_kv_heads
+        rep = h // kv
+        kvd = kv * dh
+        scale = math.sqrt(d)
+        use_rope = self._pos_kind == "rope"
+        B, MB, bs = int(max_batch), int(max_blocks), int(block_size)
+        C = MB * bs
+        cfs = [blk.moe._cf if blk.moe_experts else None
+               for blk in self.blocks]
+
+        def step(wts, kpools, vpools, tables, n_past, tokens):
+            x = _q_rows(wts["embed"], tokens) * scale       # (B, D)
+            if not use_rope:
+                x = x + _q_rows(wts["pos"], n_past)
+            blk = jnp.take_along_axis(
+                tables, (n_past // bs)[:, None], axis=1)[:, 0]
+            off = n_past % bs
+            keep = jnp.arange(C)[None, :] <= n_past[:, None]
+            new_k, new_v = [], []
+            for li, (lw, cf) in enumerate(zip(wts["layers"], cfs)):
+                xa = _jln(x, lw["ln1"])
+                qkvm = xa @ _q_mat(lw["qkv"][0]).T + lw["qkv"][1]
+                q = qkvm[:, :d].reshape(B, h, dh)
+                k = qkvm[:, d:d + kvd].reshape(B, kv, dh)
+                v = qkvm[:, d + kvd:].reshape(B, kv, dh)
+                if use_rope:
+                    q = _rope_rows(q, n_past)
+                    k = _rope_rows(k, n_past)
+                kp = kpools[li].at[blk, off].set(k)
+                vp = vpools[li].at[blk, off].set(v)
+                kc = kp[tables].reshape(B, C, kv, dh) \
+                    .transpose(0, 2, 1, 3)          # (B, kv, C, dh)
+                vc = vp[tables].reshape(B, C, kv, dh) \
+                    .transpose(0, 2, 1, 3)
+                qg = q.reshape(B, kv, rep, dh)
+                s = jnp.einsum("bkrd,bkcd->bkrc", qg, kc) \
+                    / math.sqrt(dh)
+                att = jax.nn.softmax(
+                    jnp.where(keep[:, None, None, :], s, -1e9),
+                    axis=-1)
+                o = jnp.einsum("bkrc,bkcd->bkrd", att, vc) \
+                    .reshape(B, d)
+                x = x + o @ _q_mat(lw["proj"][0]).T + lw["proj"][1]
+                xm = _jln(x, lw["ln2"])
+                x = x + _ffn_rows(lw, cf, xm)
+                new_k.append(kp)
+                new_v.append(vp)
+            logits = _jln(x, wts["ln_f"]) @ _q_mat(wts["head"]).T
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return new_k, new_v, nxt, logits
+
+        return step
 
     def train_flops_per_token(self, seq_len):
         """Deterministic matmul-FLOPs per token for one fwd+bwd step

@@ -8,6 +8,8 @@ plus an arg:/aux: params file.  The whole graph compiles to one XLA
 executable on first forward (shape-keyed jit cache), so repeat
 predictions are a single device call.
 """
+import re
+
 import numpy as np
 
 from . import symbol as sym_mod
@@ -15,7 +17,7 @@ from .context import default_context
 from .ndarray import ndarray as nd_mod
 from .ndarray.ndarray import NDArray
 
-__all__ = ["Predictor", "load_params"]
+__all__ = ["Predictor", "load_params", "serve"]
 
 
 def load_params(param_file):
@@ -24,6 +26,52 @@ def load_params(param_file):
     as args, unknown tags are ignored)."""
     from .model import split_tagged_params
     return split_tagged_params(nd_mod.load(param_file))
+
+
+def _strip_scope(name):
+    """Drop one leading gluon name-scope prefix ('transformerlm0_'
+    etc.) so params saved from one model instance load into another
+    instance of the same architecture (whose auto-prefix counter
+    differs)."""
+    return re.sub(r"^[a-z]+\d+_(?=.)", "", name, count=1)
+
+
+def _load_block_params(model, arg_params):
+    """Load a saved param dict into a gluon Block by exact name,
+    falling back to scope-prefix-stripped matching."""
+    params = model.collect_params()
+    by_suffix = {}
+    for k in arg_params:
+        by_suffix.setdefault(_strip_scope(k), k)
+    missing = []
+    for name, p in params.items():
+        src = arg_params.get(name)
+        if src is None:
+            src = arg_params.get(by_suffix.get(_strip_scope(name)))
+        if src is None:
+            missing.append(name)
+            continue
+        p.set_data(src)
+    if missing:
+        raise IOError(
+            f"parameters missing from the artifact: {missing} "
+            f"(artifact keys: {sorted(arg_params)[:8]}...)")
+
+
+def serve(param_file, model, **engine_kwargs):
+    """Serving engine over an exported/checkpointed LM artifact.
+
+    Loads ``param_file`` (saved via ``model.collect_params().save``
+    or a checkpoint's ``arg:``-tagged params) into ``model`` — a
+    ``TransformerLM`` instance matching the saved architecture — and
+    returns a :class:`~incubator_mxnet_tpu.serving.ServingEngine`
+    over it (continuous batching + paged KV cache;
+    docs/serving.md).  Engine kwargs (``max_batch``, ``quantize``,
+    ...) pass through."""
+    from .serving import ServingEngine
+    arg_params, _aux = load_params(param_file)
+    _load_block_params(model, arg_params)
+    return ServingEngine(model, **engine_kwargs)
 
 
 class Predictor:
@@ -47,6 +95,7 @@ class Predictor:
         else:
             self._symbol = sym_mod.load(symbol)
         self._ctx = ctx or default_context()
+        self._param_file = param_file
         arg_params, aux_params = load_params(param_file)
         shapes = dict(input_shapes)
         shapes.update({k: v.shape for k, v in arg_params.items()})
@@ -115,8 +164,15 @@ class Predictor:
         p = Predictor.__new__(Predictor)
         p._symbol = self._symbol
         p._ctx = self._ctx
+        p._param_file = self._param_file
         p._exec = self._exec.reshape(**input_shapes)
         p._input_names = list(self._input_names)
         p._inputs = {}
         p._outputs = None
         return p
+
+    def serve(self, model, **engine_kwargs):
+        """Serving engine over this predictor's artifact — see
+        module-level :func:`serve` (continuous batching + paged KV
+        cache over the exported weights)."""
+        return serve(self._param_file, model, **engine_kwargs)

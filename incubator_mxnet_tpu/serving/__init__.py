@@ -1,0 +1,27 @@
+"""Production inference serving tier (docs/serving.md).
+
+Continuous batching + paged KV cache + prefix caching + int8 weight
+quantization over ``TransformerLM`` — the traffic-serving layer the
+reference's C predict ABI never needed to be.
+
+    from incubator_mxnet_tpu import serving
+    eng = serving.ServingEngine(model, max_batch=8)
+    req = eng.submit(prompt_tokens, max_new_tokens=64)
+    for r, tok in eng.stream():
+        ...
+
+Or over an exported artifact: ``predictor.serve(param_file, model)``.
+"""
+from .block_table import BlockPool, BlockPoolExhausted
+from .cache_manager import PrefixCache
+from .engine import ServingEngine
+from .quantize import (quantization_error, quantize_weights,
+                       weights_nbytes)
+from .scheduler import (FAILED, FINISHED, QUEUED, RUNNING, Request,
+                        Scheduler, SchedulingError)
+
+__all__ = ["ServingEngine", "BlockPool", "BlockPoolExhausted",
+           "PrefixCache", "Request", "Scheduler", "SchedulingError",
+           "quantize_weights", "quantization_error",
+           "weights_nbytes", "QUEUED", "RUNNING", "FINISHED",
+           "FAILED"]

@@ -1,0 +1,474 @@
+"""Production inference serving engine: continuous batching over a
+paged KV cache (docs/serving.md).
+
+``TransformerLM.generate`` decodes one fixed-shape batch per call —
+fine for a notebook, fatal at traffic: a mixed stream pays worst-case
+padding, head-of-line blocking, and a dense max-length KV buffer per
+sequence.  :class:`ServingEngine` replaces that with:
+
+- **Paged KV cache** — per-layer block pools
+  (``block_table.BlockPool``); each request owns just the blocks its
+  actual length needs, gather/scatter happens by block id INSIDE the
+  jitted step, and refcounting makes shared system prompts copy-free
+  (``cache_manager.PrefixCache``).
+- **Continuous batching** — ``submit()`` enqueues, every ``step()``
+  admits waiting requests into free batch slots (one suffix prefill
+  each) and runs ONE decode step for the whole batch; finished
+  requests retire and free their blocks the same iteration.  Because
+  liveness is data (scratch-block rows), not shape, the decode step
+  compiles ONCE per engine and admission/retirement never retrace.
+- **int8 weight quantization** (``quantize.quantize_weights``) for
+  weight-stream density, dequantized inside the jit.
+
+The decode loop's only device->host sync is the per-iteration token
+read (enforced by ci/lint.py's host-sync rule over this module).
+Telemetry rides the process registry: request/ token counters,
+queue-wait / TTFT / per-token histograms, occupancy and
+pool-utilization gauges.  ``MXTPU_FAULT_SPEC`` scope
+``serve:request`` poisons the nth admission: the request is evicted
+(state ``failed``) without touching its batchmates.
+"""
+import threading
+import time
+
+import numpy as np
+
+from .. import resilience, telemetry
+from ..utils.env import get_env
+from ..utils.log import get_logger
+from .block_table import BlockPool, BlockPoolExhausted
+from .cache_manager import PrefixCache
+from .quantize import quantize_weights
+from .scheduler import (FAILED, FINISHED, QUEUED, Request, Scheduler,
+                        SchedulingError)
+
+__all__ = ["ServingEngine"]
+
+
+def _next_pow2(n):
+    return 1 << max(0, int(n - 1)).bit_length()
+
+
+class ServingEngine:
+    """Continuous-batching decode engine over one TransformerLM.
+
+    Parameters (env defaults in parentheses; docs/env_vars.md):
+
+    model : an initialized TransformerLM (``attn_window`` must be 0)
+    max_batch : concurrent decode slots (``MXTPU_SERVE_MAX_BATCH``)
+    block_size : tokens per KV block (``MXTPU_SERVE_BLOCK_SIZE``)
+    num_blocks : pool size incl. the reserved scratch block
+        (``MXTPU_SERVE_NUM_BLOCKS``)
+    quantize : ``"off"`` or ``"int8"`` (``MXTPU_SERVE_QUANT``)
+    prefix_cache : share prompt-prefix KV blocks across requests
+        (``MXTPU_SERVE_PREFIX_CACHE``)
+    keep_logits : retain each slot's last-step logits on the request
+        (device array; for validation/debugging — never host-read by
+        the engine)
+
+    Decoding is greedy (temperature-0) — the batch-invariant mode
+    whose outputs are provably identical to sequential
+    ``generate()``; sampling policies layer on later without
+    touching the cache machinery.
+
+    The engine is single-threaded: ``submit()`` may be called from
+    anywhere, but ``step()``/``stream()``/``run()`` must be driven
+    from one thread.
+    """
+
+    def __init__(self, model, max_batch=None, block_size=None,
+                 num_blocks=None, quantize=None, prefix_cache=None,
+                 keep_logits=False):
+        from ..gluon.model_zoo.transformer import TransformerLM
+        if not isinstance(model, TransformerLM):
+            raise TypeError(
+                "ServingEngine serves TransformerLM models, got "
+                f"{type(model).__name__}")
+        model._check_paged()
+        self.block_size = int(block_size if block_size is not None
+                              else get_env("MXTPU_SERVE_BLOCK_SIZE"))
+        self.num_blocks = int(num_blocks if num_blocks is not None
+                              else get_env("MXTPU_SERVE_NUM_BLOCKS"))
+        self.max_batch = int(max_batch if max_batch is not None
+                             else get_env("MXTPU_SERVE_MAX_BATCH"))
+        if self.block_size < 1 or self.max_batch < 1:
+            raise ValueError(
+                f"bad serving config: block_size={self.block_size}, "
+                f"max_batch={self.max_batch}")
+        quantize = (get_env("MXTPU_SERVE_QUANT")
+                    if quantize is None else quantize)
+        if prefix_cache is None:
+            prefix_cache = get_env("MXTPU_SERVE_PREFIX_CACHE")
+
+        self.model = model
+        # one table row spans the model's full context budget
+        self.max_blocks = -(-model._max_len // self.block_size)
+        self.pool = BlockPool(self.num_blocks, self.block_size)
+        self.cache = PrefixCache(self.pool, enabled=prefix_cache)
+        self._sched = Scheduler(self.max_batch)
+        self.keep_logits = bool(keep_logits)
+
+        wts = self._settled_weights(model)
+        if quantize in ("int8", True):
+            self._wts = quantize_weights(wts)
+            self.quantized = True
+        elif quantize in ("off", "", False, None):
+            self._wts = wts
+            self.quantized = False
+        else:
+            raise ValueError(
+                f"quantize must be 'off' or 'int8', got {quantize!r}")
+
+        import jax.numpy as jnp
+        kvh = model.n_kv_heads
+        dh = model._d // model.n_heads
+        shape = (self.num_blocks, self.block_size, kvh, dh)
+        self._kpools = [jnp.zeros(shape, jnp.float32)
+                        for _ in range(model.n_layers)]
+        self._vpools = [jnp.zeros(shape, jnp.float32)
+                        for _ in range(model.n_layers)]
+
+        self._step_fn = None
+        self._prefill_fns = {}
+        self.trace_counts = {}
+        self._next_id = 0
+        self._submit_lock = threading.Lock()
+        self._completed = []        # retired/failed since last run()
+
+        # telemetry handles cached once (no-ops when disabled)
+        self._m_requests = telemetry.counter("serving_requests_total")
+        self._m_tokens = telemetry.counter("serving_tokens_total")
+        self._m_prefill = telemetry.counter(
+            "serving_prefill_tokens_total")
+        self._m_hits = telemetry.counter(
+            "serving_prefix_cache_hits_total")
+        self._m_misses = telemetry.counter(
+            "serving_prefix_cache_misses_total")
+        self._m_preempt = telemetry.counter(
+            "serving_preemptions_total")
+        self._m_evict = telemetry.counter("serving_evictions_total")
+        self._m_occ = telemetry.gauge("serving_batch_occupancy")
+        self._m_util = telemetry.gauge(
+            "serving_block_pool_utilization")
+        self._h_wait = telemetry.histogram(
+            "serving_queue_wait_seconds")
+        self._h_ttft = telemetry.histogram("serving_ttft_seconds")
+        self._h_tok = telemetry.histogram(
+            "serving_token_latency_seconds")
+
+    # ---------------------------------------------------------- setup
+    @staticmethod
+    def _settled_weights(model):
+        from ..gluon.parameter import DeferredInitializationError
+        try:
+            return model._decode_weights()
+        except DeferredInitializationError:
+            # deferred-init params (LayerNorm shapes): settle with a
+            # tiny probe forward, exactly as generate() does
+            import jax.numpy as jnp
+
+            from .. import autograd, ndarray as nd
+            with autograd.pause():
+                model.forward(
+                    nd.NDArray(jnp.zeros((1, 1), jnp.int32)))
+            return model._decode_weights()
+
+    def _counted_jit(self, name, fn):
+        import jax
+
+        def traced(*args):
+            # runs at TRACE time only: the regression tests assert
+            # admission/retirement replay the compiled step
+            self.trace_counts[name] = \
+                self.trace_counts.get(name, 0) + 1
+            return fn(*args)
+
+        # donate the KV pools (args 1, 2 in both the prefill and the
+        # step signature): the compiled call updates the cache IN
+        # PLACE instead of copying every pool array out per token —
+        # the engine always rebinds self._kpools/_vpools from the
+        # outputs, so the consumed buffers are never reused
+        return jax.jit(traced, donate_argnums=(1, 2))
+
+    def _get_step_fn(self):
+        if self._step_fn is None:
+            self._step_fn = self._counted_jit(
+                "decode", self.model._build_paged_step(
+                    self.max_batch, self.max_blocks,
+                    self.block_size))
+        return self._step_fn
+
+    def _get_prefill_fn(self, suffix_len):
+        # pow2 buckets, floored at one block: a prefix-cache hit can
+        # shrink the suffix to a couple of tokens, and compiling a
+        # dedicated tiny executable per length would cost far more
+        # than the padded rows it saves
+        bucket = min(max(_next_pow2(suffix_len),
+                         _next_pow2(self.block_size)),
+                     _next_pow2(self.model._max_len))
+        fn = self._prefill_fns.get(bucket)
+        if fn is None:
+            fn = self._prefill_fns[bucket] = self._counted_jit(
+                f"prefill_{bucket}", self.model._build_paged_prefill(
+                    bucket, self.max_blocks, self.block_size))
+        return bucket, fn
+
+    # ------------------------------------------------------------- API
+    def submit(self, tokens, max_new_tokens, eos_id=None):
+        """Enqueue a prompt; returns its :class:`Request` handle.
+
+        ``tokens`` is a 1D int sequence (list / numpy / NDArray).
+        The handle's ``generated`` list fills as the engine runs
+        (drive it via :meth:`step`, :meth:`stream` or :meth:`run`)."""
+        if hasattr(tokens, "asnumpy"):
+            tokens = tokens.asnumpy()
+        toks = [int(t) for t in np.asarray(tokens).ravel()]
+        max_new = int(max_new_tokens)
+        if not toks:
+            raise ValueError("empty prompt")
+        if max_new < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1 (got {max_new})")
+        total = len(toks) + max_new
+        if total > self.model._max_len:
+            raise ValueError(
+                f"prompt+new = {total} exceeds max_len "
+                f"{self.model._max_len}")
+        need = -(-total // self.block_size)
+        if need > min(self.max_blocks, self.pool.capacity):
+            raise ValueError(
+                f"request needs {need} blocks but the pool serves "
+                f"at most {min(self.max_blocks, self.pool.capacity)}"
+                " per sequence — raise MXTPU_SERVE_NUM_BLOCKS or "
+                "shrink the request")
+        with self._submit_lock:     # submit() may race across threads
+            req = Request(self._next_id, toks, max_new,
+                          eos_id=eos_id)
+            self._next_id += 1
+            self._sched.add(req)
+        self._m_requests.inc()
+        return req
+
+    def has_work(self):
+        """Whether any submitted request is still queued/running."""
+        return self._sched.has_work()
+
+    def step(self):
+        """One continuous-batching iteration: admit -> grow ->
+        decode -> retire.  Returns the ``(request, token_id)``
+        events emitted this iteration."""
+        events = []
+        self._admit(events)
+        if self._sched.any_running():
+            self._grow()
+        if self._sched.any_running():
+            self._decode_once(events)
+        self._m_occ.set(self._sched.n_running() / self.max_batch)
+        self._m_util.set(self.pool.utilization())
+        return events
+
+    def stream(self):
+        """Drive the engine, yielding ``(request, token_id)`` events
+        as they are produced, until all submitted work drains."""
+        while self._sched.has_work():
+            for ev in self.step():
+                yield ev
+
+    def run(self):
+        """Drain everything; returns ``{request_id: full token
+        list}`` for every request that finished during this call
+        (failed requests are included with their partial output —
+        check ``request.state``)."""
+        for _ev in self.stream():
+            pass
+        done, self._completed = self._completed, []
+        return {req.id: req.tokens for req in done}
+
+    # ------------------------------------------------------ internals
+    def _alloc(self, n):
+        """Pool alloc with prefix-cache eviction as the fallback."""
+        try:
+            return self.pool.alloc(n)
+        except BlockPoolExhausted:
+            self.cache.evict(n - self.pool.num_free)
+            return self.pool.alloc(n)       # may re-raise
+
+    def _admit(self, events):
+        """Fill free slots from the waiting queue; one suffix
+        prefill per admission (prefix-cache hits skip the shared
+        blocks)."""
+        import jax
+        import jax.numpy as jnp
+        while self._sched.has_waiting():
+            slot = self._sched.free_slot()
+            if slot is None:
+                return
+            req = self._sched.pop_waiting()
+            try:
+                resilience.inject("serve", "request")
+            except resilience.TransientError as exc:
+                self._fail(req, exc)
+                continue
+            toks = req.tokens
+            matched, n_cached = self.cache.match(toks)
+            need = -(-len(toks) // self.block_size) - len(matched)
+            try:
+                fresh = self._alloc(need)
+            except BlockPoolExhausted:
+                if matched:
+                    self.pool.free(matched)     # release the match
+                self._sched.push_front(req)
+                if not self._sched.any_running():
+                    raise SchedulingError(
+                        f"request {req.id} needs {need} fresh "
+                        "blocks but the pool cannot ever provide "
+                        "them — raise MXTPU_SERVE_NUM_BLOCKS")
+                return                          # wait for frees
+            req.admit_ts = time.monotonic()
+            self._h_wait.observe(req.admit_ts - req.submit_ts)
+            self._m_hits.inc(n_cached)
+            self._m_misses.inc(len(toks) - n_cached)
+            req.block_ids = matched + fresh
+            self._sched.place(req, slot)
+
+            suffix = toks[n_cached:]
+            bucket, fn = self._get_prefill_fn(len(suffix))
+            suf = np.zeros(bucket, np.int32)
+            suf[:len(suffix)] = suffix
+            row = np.zeros(self.max_blocks, np.int32)
+            row[:len(req.block_ids)] = req.block_ids
+            with telemetry.span("serve_prefill"):
+                self._kpools, self._vpools, nxt, logits = fn(
+                    self._wts, self._kpools, self._vpools,
+                    jnp.asarray(row), np.int32(n_cached),
+                    jnp.asarray(suf), np.int32(len(suffix)))
+                # completion barrier, not a transfer: dispatching the
+                # next call while its DONATED pool buffers are still
+                # pending hits a pathological slow path (~7x) in the
+                # runtime's donation bookkeeping
+                jax.block_until_ready(self._kpools)
+            self._m_prefill.inc(len(suffix))
+            if self.keep_logits:
+                req.logits = logits
+            # register this stream's full blocks for future sharing
+            self.cache.insert(toks, req.block_ids)
+            req.n_past = len(toks)
+            tok = int(np.asarray(nxt))  # sync-ok: first-token read seeds the decode loop
+            self._append_token(req, tok, events)
+
+    def _grow(self):
+        """Ensure every runner owns the block its next position
+        writes into; preempt the latest-admitted runner on
+        exhaustion."""
+        bs = self.block_size
+        for req in sorted(self._sched.running(),
+                          key=lambda r: r.admit_seq):
+            if req.done or req.slot is None:
+                continue        # preempted earlier in this pass
+            if req.n_past // bs < len(req.block_ids):
+                continue
+            while True:
+                try:
+                    req.block_ids += self._alloc(1)
+                    break
+                except BlockPoolExhausted:
+                    victim = self._sched.latest_running()
+                    if victim is req and self._sched.n_running() == 1:
+                        raise SchedulingError(
+                            "block pool exhausted with a single "
+                            "running request — the pool cannot hold "
+                            "one full sequence; raise "
+                            "MXTPU_SERVE_NUM_BLOCKS")
+                    self._preempt(victim)
+                    if victim is req:
+                        break               # we preempted ourselves
+
+    def _preempt(self, req):
+        """Free a runner's blocks and re-queue it (front).  Its
+        generated tokens survive; re-admission re-prefills
+        prompt+generated (cheap again once the prefix cache holds
+        the shared blocks)."""
+        self._sched.clear(req)
+        if req.block_ids:
+            self.pool.free(req.block_ids)
+        req.block_ids = []
+        req.n_past = 0
+        req.state = QUEUED
+        req.preemptions += 1
+        self._m_preempt.inc()
+        self._sched.push_front(req)
+
+    def _decode_once(self, events):
+        """One batched decode step + the per-iteration token read."""
+        import jax
+        import jax.numpy as jnp
+        B, MB = self.max_batch, self.max_blocks
+        tokens = np.zeros(B, np.int32)
+        npast = np.zeros(B, np.int32)
+        tables = np.zeros((B, MB), np.int32)
+        slots = self._sched.slots
+        for i, req in enumerate(slots):
+            if req is None:
+                continue
+            tokens[i] = req.generated[-1]
+            npast[i] = req.n_past
+            tables[i, :len(req.block_ids)] = req.block_ids
+        fn = self._get_step_fn()
+        with telemetry.span("serve_decode"):
+            self._kpools, self._vpools, nxt, logits = fn(
+                self._wts, self._kpools, self._vpools,
+                jnp.asarray(tables), jnp.asarray(npast),
+                jnp.asarray(tokens))
+            # completion barrier (see _admit): the token read below
+            # already serializes the loop; waiting on the donated
+            # pools too keeps the NEXT dispatch off the slow path
+            jax.block_until_ready(self._kpools)
+        toks = np.asarray(nxt)  # sync-ok: the per-iteration token read
+        for i, req in enumerate(list(slots)):
+            if req is None:
+                continue
+            req.n_past += 1
+            if self.keep_logits:
+                req.logits = logits[i]
+            self._append_token(req, int(toks[i]), events)
+
+    def _append_token(self, req, tok, events):
+        """Record one emitted token; retire the request when its
+        budget or EOS is reached."""
+        now = time.monotonic()
+        if req.first_token_ts is None:
+            req.first_token_ts = now
+            self._h_ttft.observe(now - req.submit_ts)
+        else:
+            self._h_tok.observe(now - req.last_token_ts)
+        req.last_token_ts = now
+        req.generated.append(tok)
+        self._m_tokens.inc()
+        events.append((req, tok))
+        if (len(req.generated) >= req.max_new_tokens
+                or (req.eos_id is not None and tok == req.eos_id)):
+            self._retire(req)
+
+    def _retire(self, req):
+        self._sched.clear(req)
+        if req.block_ids:
+            self.pool.free(req.block_ids)
+        req.block_ids = []
+        req.state = FINISHED
+        req.finish_ts = time.monotonic()
+        self._completed.append(req)
+
+    def _fail(self, req, exc):
+        """Evict a poisoned request without touching batchmates."""
+        get_logger().warning(
+            "serving: evicting request %s after injected/terminal "
+            "fault: %s", req.id, exc)
+        self._sched.clear(req)
+        if req.block_ids:
+            self.pool.free(req.block_ids)
+        req.block_ids = []
+        req.state = FAILED
+        req.error = exc
+        req.finish_ts = time.monotonic()
+        self._m_evict.inc()
+        self._completed.append(req)

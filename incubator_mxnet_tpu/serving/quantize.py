@@ -1,0 +1,105 @@
+"""int8 weight quantization for serving density.
+
+Serving throughput on a memory-bound accelerator is set by how many
+weight bytes stream per decode step; int8 storage with per-output-
+channel fp32 scales cuts that ~4x versus fp32 at <0.5% logit error
+for trained transformer weights (symmetric absmax quantization, the
+standard W8 recipe).
+
+The representation keeps the weight pytree's SHAPE: every 2D float
+matrix in a ``TransformerLM._decode_weights()`` tree (qkv / proj /
+up / down projections, the tied head, and the embedding tables)
+becomes ``{"q": int8 (out, in), "s": float32 (out,)}``; biases,
+LayerNorm affines, and stacked 3D MoE expert weights stay fp32.  The
+paged prefill/step builders (gluon/model_zoo/transformer.py) detect
+the dict leaves at trace time and dequantize at use — matmul weights
+as ``q.astype(f32) * s[:, None]`` inside the jit (XLA fuses the
+dequant into the matmul read), embedding tables per GATHERED row
+only, so a decode step never materializes a dense fp32 table.
+
+``quantize_weights`` validates nothing by itself; the serving bench
+and tests/test_serving.py compare int8 vs fp32 logits end-to-end.
+"""
+
+__all__ = ["quantize_weights", "quantization_error",
+           "weights_nbytes"]
+
+
+def _q2d(w):
+    """Symmetric absmax int8 per output channel (axis 0)."""
+    import jax.numpy as jnp
+    amax = jnp.max(jnp.abs(w), axis=1)
+    s = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(w / s[:, None]), -127, 127) \
+        .astype(jnp.int8)
+    return {"q": q, "s": s}
+
+
+def quantize_weights(wts, include_embeddings=True):
+    """Quantize a ``TransformerLM._decode_weights()`` pytree to int8.
+
+    Returns a NEW tree (the input is untouched) with every dense 2D
+    projection replaced by ``{"q", "s"}`` pairs.  With
+    ``include_embeddings=False`` the token/position tables stay fp32
+    (their gathers are cheap; quantizing them trades a little logit
+    accuracy for the largest single density win on big vocabs)."""
+    out = {"ln_f": wts["ln_f"], "layers": []}
+    if include_embeddings:
+        out["embed"] = _q2d(wts["embed"])
+        if "pos" in wts:
+            out["pos"] = _q2d(wts["pos"])
+    else:
+        out["embed"] = wts["embed"]
+        if "pos" in wts:
+            out["pos"] = wts["pos"]
+    out["head"] = _q2d(wts["head"])
+    for lw in wts["layers"]:
+        nl = dict(ln1=lw["ln1"], ln2=lw["ln2"],
+                  qkv=(_q2d(lw["qkv"][0]), lw["qkv"][1]),
+                  proj=(_q2d(lw["proj"][0]), lw["proj"][1]))
+        if "moe" in lw:
+            # stacked (E, H, D) expert weights keep fp32: per-expert
+            # per-channel scales would need a 3D scale plan — out of
+            # scope for the density this tier targets
+            nl["moe"] = lw["moe"]
+        else:
+            nl["up"] = (_q2d(lw["up"][0]), lw["up"][1])
+            nl["down"] = (_q2d(lw["down"][0]), lw["down"][1])
+        out["layers"].append(nl)
+    return out
+
+
+def quantization_error(wts, qwts):
+    """Max relative reconstruction error over quantized matrices —
+    a cheap sanity probe (the real acceptance is logit-level)."""
+    import jax.numpy as jnp
+
+    def leaf_err(w, q):
+        deq = q["q"].astype(jnp.float32) * q["s"][:, None]
+        denom = jnp.maximum(jnp.max(jnp.abs(w)), 1e-12)
+        return float(jnp.max(jnp.abs(deq - w)) / denom)
+
+    errs = []
+
+    def walk(a, b):
+        if isinstance(b, dict) and set(b) == {"q", "s"}:
+            errs.append(leaf_err(a, b))
+        elif isinstance(b, dict):
+            for k in b:
+                walk(a[k], b[k])
+        elif isinstance(b, (list, tuple)):
+            for x, y in zip(a, b):
+                walk(x, y)
+
+    walk(wts, qwts)
+    return max(errs) if errs else 0.0
+
+
+def weights_nbytes(wts):
+    """Total bytes of every array leaf (int8 payloads + scales
+    included) — the density number the bench reports."""
+    import jax
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(wts):
+        total += leaf.size * leaf.dtype.itemsize
+    return int(total)

@@ -1,0 +1,155 @@
+"""Continuous-batching scheduler state: requests, slots, queue.
+
+Policy (docs/serving.md):
+
+- **FIFO admission, prefill-prioritized.**  Every engine iteration
+  first fills free batch slots from the waiting queue (one prefill
+  per admission), then runs ONE decode step for the whole batch —
+  so a new request's first token never waits behind an entire
+  stream's decode, and decode throughput is only briefly traded for
+  time-to-first-token.
+- **Preemption by block exhaustion.**  When a running sequence needs
+  its next KV block and the pool (after prefix-cache eviction) has
+  none, the LATEST-admitted running request is preempted: its blocks
+  free immediately, and it re-queues at the FRONT with its generated
+  tokens intact.  Re-admission re-prefills prompt+generated — with
+  the prefix cache warm this is usually a cheap suffix prefill — and
+  greedy decoding makes the recompute exact, so preemption is
+  invisible in the output stream.
+- **Retirement on the spot.**  A request that emits its last token
+  (budget or EOS) frees its blocks in the same iteration, so the
+  next iteration's admissions see the memory.
+
+The scheduler is pure host-side bookkeeping; device state (pools,
+compiled steps) lives in engine.py.
+"""
+import time
+from collections import deque
+
+__all__ = ["Request", "Scheduler", "SchedulingError",
+           "QUEUED", "RUNNING", "FINISHED", "FAILED"]
+
+QUEUED = "queued"
+RUNNING = "running"
+FINISHED = "finished"
+FAILED = "failed"
+
+
+class SchedulingError(RuntimeError):
+    """The schedule cannot make progress (e.g. a single request
+    needs more blocks than the whole pool holds)."""
+
+
+class Request:
+    """One generation request flowing through the engine.
+
+    ``prompt`` is immutable; ``generated`` accumulates emitted
+    tokens (and survives preemption — re-admission prefills
+    ``prompt + generated``).  Timing fields are host monotonic
+    stamps feeding the queue-wait / TTFT / per-token histograms.
+    """
+
+    __slots__ = ("id", "prompt", "max_new_tokens", "eos_id", "state",
+                 "generated", "block_ids", "n_past", "slot",
+                 "admit_seq", "preemptions", "error", "logits",
+                 "submit_ts", "admit_ts", "first_token_ts",
+                 "last_token_ts", "finish_ts")
+
+    def __init__(self, req_id, prompt, max_new_tokens, eos_id=None):
+        self.id = req_id
+        self.prompt = list(prompt)
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = eos_id
+        self.state = QUEUED
+        self.generated = []
+        self.block_ids = []
+        self.n_past = 0
+        self.slot = None
+        self.admit_seq = -1
+        self.preemptions = 0
+        self.error = None
+        self.logits = None
+        self.submit_ts = time.monotonic()
+        self.admit_ts = None
+        self.first_token_ts = None
+        self.last_token_ts = None
+        self.finish_ts = None
+
+    @property
+    def done(self):
+        return self.state in (FINISHED, FAILED)
+
+    @property
+    def tokens(self):
+        """Full stream: prompt + generated so far."""
+        return self.prompt + self.generated
+
+    def __repr__(self):
+        return (f"Request(id={self.id}, state={self.state}, "
+                f"prompt={len(self.prompt)}t, "
+                f"generated={len(self.generated)}/"
+                f"{self.max_new_tokens})")
+
+
+class Scheduler:
+    """Waiting queue + fixed slot array for ``max_batch`` runners."""
+
+    def __init__(self, max_batch):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1 ({max_batch})")
+        self.max_batch = int(max_batch)
+        self.slots = [None] * self.max_batch
+        self.waiting = deque()
+        self._admit_counter = 0
+
+    # ------------------------------------------------------- queue
+    def add(self, req):
+        self.waiting.append(req)
+
+    def push_front(self, req):
+        """Re-queue at the head (preemption / failed admission)."""
+        self.waiting.appendleft(req)
+
+    def pop_waiting(self):
+        return self.waiting.popleft() if self.waiting else None
+
+    def has_waiting(self):
+        return bool(self.waiting)
+
+    # ------------------------------------------------------- slots
+    def free_slot(self):
+        """Index of a free slot, or None when the batch is full."""
+        for i, r in enumerate(self.slots):
+            if r is None:
+                return i
+        return None
+
+    def place(self, req, slot):
+        assert self.slots[slot] is None
+        self.slots[slot] = req
+        req.slot = slot
+        req.state = RUNNING
+        req.admit_seq = self._admit_counter
+        self._admit_counter += 1
+
+    def clear(self, req):
+        if req.slot is not None:
+            self.slots[req.slot] = None
+            req.slot = None
+
+    def running(self):
+        return [r for r in self.slots if r is not None]
+
+    def n_running(self):
+        return sum(1 for r in self.slots if r is not None)
+
+    def any_running(self):
+        return any(r is not None for r in self.slots)
+
+    def latest_running(self):
+        """Preemption victim: the most recently admitted runner."""
+        live = self.running()
+        return max(live, key=lambda r: r.admit_seq) if live else None
+
+    def has_work(self):
+        return bool(self.waiting) or self.any_running()

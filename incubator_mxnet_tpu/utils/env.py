@@ -84,6 +84,27 @@ register_env("MXTPU_CACHEDOP_CAPACITY", int, 64,
              "max compiled signatures a hybridized block's CachedOp "
              "retains (LRU eviction); <=0 disables the bound")
 
+# Serving tier (serving/; docs/serving.md).
+register_env("MXTPU_SERVE_BLOCK_SIZE", int, 16,
+             "tokens per paged-KV block in the serving engine; "
+             "smaller = less tail waste per sequence, larger = "
+             "fewer gather indices per step")
+register_env("MXTPU_SERVE_NUM_BLOCKS", int, 512,
+             "KV block-pool size per layer (block 0 is the reserved "
+             "scratch block); bounds total serving HBM at "
+             "n_layers * 2 * num_blocks * block_size * kv_heads * "
+             "head_dim floats")
+register_env("MXTPU_SERVE_MAX_BATCH", int, 8,
+             "concurrent decode slots in the continuous-batching "
+             "scheduler (the compiled step's batch dimension)")
+register_env("MXTPU_SERVE_PREFIX_CACHE", bool, True,
+             "share prompt-prefix KV blocks across requests by "
+             "token-hash (copy-free; refcounted); 0 disables")
+register_env("MXTPU_SERVE_QUANT", str, "off",
+             "serving weight quantization: 'off' (fp32) or 'int8' "
+             "(per-output-channel symmetric, fp32 scales, "
+             "dequantized inside the compiled step)")
+
 # Resilience layer (resilience.py; docs/resilience.md).
 register_env("MXTPU_COLLECTIVE_TIMEOUT", float, 600.0,
              "wall-clock deadline (s) for dist collectives; a hung "

@@ -287,6 +287,40 @@ def test_generate_sampling_arg_validation():
     assert len(net._gen_cache) == 1
 
 
+def test_gen_cache_is_lru_not_fifo(monkeypatch):
+    """Regression: the decode-executable cache must evict the LEAST
+    RECENTLY USED signature, not the oldest inserted — an
+    alternating pair of hot signatures at capacity used to thrash
+    recompiles under FIFO."""
+    net = _tiny(max_len=16)
+    builds = []
+    real_build = net._build_decode
+
+    def counting_build(b, p, max_new, sample, top_k=0, top_p=1.0):
+        builds.append((b, p, max_new))
+        return real_build(b, p, max_new, sample, top_k=top_k,
+                          top_p=top_p)
+
+    monkeypatch.setattr(net, "_build_decode", counting_build)
+    monkeypatch.setattr(TransformerLM, "_GEN_CACHE_MAX", 2)
+    prompt_a = mx.nd.array(np.zeros((1, 4), "int32"))
+    prompt_b = mx.nd.array(np.zeros((1, 5), "int32"))
+    prompt_c = mx.nd.array(np.zeros((1, 6), "int32"))
+    net.generate(prompt_a, 2)          # build A
+    net.generate(prompt_b, 2)          # build B (cache full)
+    net.generate(prompt_a, 2)          # hit A -> A becomes MRU
+    net.generate(prompt_c, 2)          # build C, evicts B (LRU)
+    assert len(builds) == 3
+    net.generate(prompt_a, 2)          # FIFO would have evicted A
+    assert len(builds) == 3, \
+        "hot signature was evicted despite a recent hit (FIFO)"
+    # the pair (A, C) now alternates at capacity with no rebuilds
+    for _ in range(3):
+        net.generate(prompt_a, 2)
+        net.generate(prompt_c, 2)
+    assert len(builds) == 3
+
+
 def test_seq_parallel_ulysses_matches_local(tmp_path):
     """seq_parallel='ulysses' under an sp>1 mesh computes the SAME
     values as local attention (all-to-all resharding is exact)."""
