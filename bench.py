@@ -706,6 +706,209 @@ def _bench_serving(dev, platform):
     }))
 
 
+def _bench_tracing(dev, platform):
+    """Flight-recorder bench (ISSUE 9 acceptance): the serving
+    stream from the ISSUE 7 bench run (a) with MXTPU_TELEMETRY=0 and
+    (b) with tracing ON — reporting per-request TTFT decomposition
+    (queue wait / prefill / decode per request from
+    ``ServingEngine.stats()``), the compile-event ledger (one compile
+    per signature, each carrying its attribution reason), tracing
+    overhead on serving throughput, and a fault-injected
+    (serve:request eviction + grad:nonfinite divergence) run's
+    flight-recorder dump.  CPU-measurable; writes BENCH_r09.json."""
+    import tempfile
+    import warnings
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import (autograd, gluon, nd, resilience,
+                                     tracing)
+    from incubator_mxnet_tpu.gluon import nn
+    from incubator_mxnet_tpu.gluon.model_zoo.transformer import \
+        TransformerLM
+    from incubator_mxnet_tpu.serving import ServingEngine
+
+    del dev
+    mx.random.seed(0)
+    rs = np.random.RandomState(7)
+    vocab, d, layers, heads, max_len = 512, 256, 4, 8, 128
+    n_req = int(os.environ.get("MXTPU_BENCH_SERVE_REQS", "16"))
+    max_new = int(os.environ.get("MXTPU_BENCH_SERVE_NEW", "32"))
+    _stage(f"building LM d={d} L={layers} ({n_req} requests x "
+           f"{max_new} new tokens)", tag="trace")
+    net = TransformerLM(vocab, d_model=d, n_layers=layers,
+                        n_heads=heads, max_len=max_len)
+    net.initialize(mx.init.Xavier())
+    system = list(rs.randint(0, vocab, 24))
+    prompts = []
+    for i in range(n_req):
+        own = list(rs.randint(0, vocab, int(rs.randint(8, 40))))
+        p = (system + own) if i % 2 == 0 else own
+        prompts.append(p[:max_len - max_new - 1])
+    ntok = n_req * max_new
+
+    def measured_engine():
+        """One engine: compile-warm + cache-warm passes, then the
+        best of three measured saturated passes (tokens/s)."""
+        eng = ServingEngine(net, max_batch=8, block_size=16,
+                            num_blocks=192)
+
+        def one_pass():
+            t0 = time.perf_counter()
+            for p in prompts:
+                eng.submit(p, max_new)
+            eng.run()
+            return time.perf_counter() - t0
+
+        one_pass()      # compiles prefill buckets + decode step
+        one_pass()      # warm prefix cache's smaller buckets
+        return min(one_pass() for _ in range(3)), eng
+
+    prev_tel = os.environ.get("MXTPU_TELEMETRY")
+    try:
+        os.environ["MXTPU_TELEMETRY"] = "0"
+        _stage("serving pass, tracing OFF (MXTPU_TELEMETRY=0)",
+               tag="trace")
+        off_s, _ = measured_engine()
+        os.environ["MXTPU_TELEMETRY"] = "1"
+        tracing.reset_for_tests()   # clean ledger for the ON run
+        _stage("serving pass, tracing ON", tag="trace")
+        on_s, eng = measured_engine()
+    finally:
+        if prev_tel is None:
+            os.environ.pop("MXTPU_TELEMETRY", None)
+        else:
+            os.environ["MXTPU_TELEMETRY"] = prev_tel
+    overhead = (on_s - off_s) / off_s
+    _stage(f"tracing overhead {overhead * 100:.2f}% "
+           f"({ntok / off_s:.0f} -> {ntok / on_s:.0f} tok/s)",
+           tag="trace")
+
+    # ---- per-request TTFT decomposition -------------------------
+    summaries = list(eng.stats()["requests"])[-n_req:]
+    decomposition = [
+        {k: s[k] for k in ("id", "state", "queue_wait_s",
+                           "prefill_s", "ttft_s", "decode_s",
+                           "tokens_generated", "preemptions")}
+        for s in summaries]
+    lifecycle_complete = all(
+        s["state"] == "finished" and s["ttft_s"] is not None
+        and s["queue_wait_s"] is not None for s in summaries)
+
+    # ---- compile-event ledger -----------------------------------
+    compile_evs = tracing.events("compile")
+    sigs = {(e["site"], json.dumps(e["signature"], sort_keys=True))
+            for e in compile_evs}
+    compile_ledger = [
+        {"site": e["site"], "reason": e["reason"],
+         "seconds": e["seconds"]} for e in compile_evs]
+    one_per_signature = len(compile_evs) == len(sigs)
+    all_attributed = all(e["reason"] for e in compile_evs)
+
+    # ---- fault dump: eviction + divergence ----------------------
+    _stage("fault-injected run (eviction + divergence) -> dump",
+           tag="trace")
+    dump_path = os.path.join(tempfile.mkdtemp(prefix="mxtpu_fr_"),
+                             "flight.jsonl")
+    prev_env = {k: os.environ.get(k) for k in
+                ("MXTPU_TRACE_DUMP", "MXTPU_FAULT_SPEC",
+                 "MXTPU_NONFINITE_POLICY", "MXTPU_MAX_BAD_STEPS")}
+    try:
+        os.environ["MXTPU_TRACE_DUMP"] = dump_path
+        os.environ["MXTPU_FAULT_SPEC"] = \
+            "serve:request:2:error,grad:nonfinite:*:nan"
+        os.environ["MXTPU_NONFINITE_POLICY"] = "skip"
+        os.environ["MXTPU_MAX_BAD_STEPS"] = "3"
+        resilience.reset_faults()
+        feng = ServingEngine(net, max_batch=2, block_size=16,
+                             num_blocks=64)
+        freqs = [feng.submit(p, 4) for p in prompts[:3]]
+        feng.run()
+        evicted = [r for r in freqs if r.state == "failed"]
+        mlp = nn.HybridSequential()
+        mlp.add(nn.Dense(16, activation="relu"))
+        mlp.add(nn.Dense(3))
+        mlp.initialize(mx.init.Xavier())
+        trainer = gluon.Trainer(mlp.collect_params(), "sgd",
+                                {"learning_rate": 0.1})
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        x = nd.array(rs.randn(10, 8).astype("float32"))
+        y = nd.array(rs.randint(0, 3, 10).astype("float32"))
+        diverged = False
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            try:
+                for _ in range(8):
+                    with autograd.record():
+                        loss = loss_fn(mlp(x), y)
+                    loss.backward()
+                    trainer.step(10)
+            except resilience.DivergedError:
+                diverged = True
+    finally:
+        for k, v in prev_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        resilience.reset_faults()
+    dump_lines = []
+    if os.path.exists(dump_path):
+        with open(dump_path) as f:
+            dump_lines = [json.loads(line) for line in f]
+    evicted_id = evicted[0].id if evicted else None
+    dump_events = dump_lines[1:] if dump_lines else []
+    evicted_lifecycle = sorted(
+        e["event"] for e in dump_events
+        if e.get("rid") == evicted_id
+        and e.get("engine") == feng.engine_id)
+    fault_dump = {
+        "path": dump_path,
+        "exists": bool(dump_lines),
+        "reason": dump_lines[0]["reason"] if dump_lines else None,
+        "events": len(dump_events),
+        "diverged": diverged,
+        "evicted_request": evicted_id,
+        "evicted_lifecycle_events": evicted_lifecycle,
+        "sentinel_events": sum(
+            1 for e in dump_events
+            if e["event"].startswith("sentinel_")),
+    }
+
+    artifact = {
+        "metric": "tracing_flight_recorder",
+        "platform": platform,
+        "stream": {"requests": n_req, "max_new_tokens": max_new},
+        "throughput": {
+            "tokens_per_s_telemetry_off": round(ntok / off_s, 1),
+            "tokens_per_s_tracing_on": round(ntok / on_s, 1),
+            "overhead_pct": round(overhead * 100, 2),
+            "overhead_under_2pct": overhead < 0.02},
+        "ttft_decomposition_per_request": decomposition,
+        "lifecycle_complete": lifecycle_complete,
+        "compile_ledger": compile_ledger,
+        "one_compile_per_signature": one_per_signature,
+        "every_compile_attributed": all_attributed,
+        "fault_dump": fault_dump,
+    }
+    out_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_r09.json")
+    with open(out_path, "w") as f:
+        f.write(json.dumps(artifact, indent=2) + "\n")
+    print(json.dumps({
+        "metric": "tracing_flight_recorder",
+        "value": artifact["throughput"]["overhead_pct"],
+        "unit": "pct_overhead_vs_telemetry_off",
+        "platform": platform,
+        "tokens_per_s_on": artifact["throughput"][
+            "tokens_per_s_tracing_on"],
+        "one_compile_per_signature": one_per_signature,
+        "lifecycle_complete": lifecycle_complete,
+        "fault_dump_events": fault_dump["events"],
+        "diverged_and_dumped": diverged and fault_dump["exists"],
+        "artifact": "BENCH_r09.json",
+    }))
+
+
 def _make_synthetic_rec(path_prefix, n, edge=224):
     """Write n real JPEGs (structured noise) into an indexed .rec."""
     import io as _pyio
@@ -863,6 +1066,9 @@ def main():
         return
     if os.environ.get("MXTPU_BENCH_MODEL") == "serving":
         _bench_serving(dev, platform)
+        return
+    if os.environ.get("MXTPU_BENCH_MODEL") == "tracing":
+        _bench_tracing(dev, platform)
         return
 
     import incubator_mxnet_tpu as mx

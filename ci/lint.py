@@ -61,13 +61,20 @@ HOT_SYNC_FILES = (
     # sync-ok); anything else would serialize the decode stream
     "incubator_mxnet_tpu/serving/engine.py",
     "incubator_mxnet_tpu/serving/scheduler.py",
+    # flight recorder: memory sampling rides the heartbeat and must
+    # read array METADATA only — an accidental device sync here
+    # would stall the hot paths every beat
+    "incubator_mxnet_tpu/tracing.py",
 )
 HOT_SYNC_FUNCS = {"step", "update", "__call__", "begin_step",
                   "guarded_step_begin", "read_window_bad",
                   "accumulate_window", "all_finite",
                   # serving scheduler loop + decode step
                   "_admit", "_grow", "_decode_once", "_append_token",
-                  "_retire", "_preempt", "_fail", "stream", "run"}
+                  "_retire", "_preempt", "_fail", "stream", "run",
+                  # tracing producers + memory sampling
+                  "trace_event", "record", "device_memory_stats",
+                  "update_memory_gauges", "_rss_bytes"}
 # attrs that always sync, and ones that sync only for specific roots
 SYNC_ATTRS = {"item", "asscalar", "asnumpy"}
 SYNC_ROOT_ATTRS = {("np", "asarray"), ("numpy", "asarray"),
@@ -100,6 +107,12 @@ SPAN_TIMING_MODULES = (
 # table of docs/observability.md — same discipline as the env-var
 # registry, so `snapshot()` output is always documented.
 METRIC_FACTORIES = {"counter", "gauge", "histogram", "span"}
+
+# flight-recorder event factory: a string literal passed to
+# tracing.trace_event is a trace-event name and must be declared in
+# the event catalog of docs/observability.md — an operator reading a
+# post-mortem dump must always find the event's meaning.
+TRACE_EVENT_FACTORIES = {"trace_event"}
 
 # The symbolic-IR graph is owned by the pass pipeline: outside
 # incubator_mxnet_tpu/graph/ and /symbol/, code must treat `_Node`
@@ -470,6 +483,13 @@ def check_metric_catalog(files):
                 problems.append(
                     f"{path}:{node.lineno}: metric/span name "
                     f"{name!r} is not declared in the catalog table "
+                    "of docs/observability.md")
+            if fname in TRACE_EVENT_FACTORIES \
+                    and name_re.match(name) \
+                    and f"`{name}`" not in catalog:
+                problems.append(
+                    f"{path}:{node.lineno}: trace-event name "
+                    f"{name!r} is not declared in the event catalog "
                     "of docs/observability.md")
     return sorted(set(problems))
 

@@ -49,6 +49,7 @@ from . import test_utils
 from . import dist
 from . import resilience
 from . import telemetry
+from . import tracing
 from . import predictor
 from .predictor import Predictor
 from .model import load_checkpoint, save_checkpoint

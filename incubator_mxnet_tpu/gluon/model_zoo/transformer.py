@@ -10,11 +10,13 @@ for sequence-parallel scale-out the attention core swaps for
 parallel.ring_attention (see parallel/ring_attention.py).
 """
 import math
+import time
 from collections import OrderedDict
 
 import numpy as np
 
 from ... import ndarray as nd
+from ... import tracing
 from ..block import Block
 from ..nn import Dense, Dropout, Embedding, LayerNorm
 
@@ -474,7 +476,9 @@ class TransformerLM(Block):
             # signatures at capacity must not thrash recompiles
             cache = self._gen_cache = OrderedDict(cache or {})
         fn = cache.get(key)
-        if fn is None:
+        missed = fn is None
+        t0 = time.monotonic()
+        if missed:
             if len(cache) >= self._GEN_CACHE_MAX:
                 cache.popitem(last=False)       # least recently used
             fn = cache[key] = jax.jit(self._build_decode(
@@ -487,6 +491,15 @@ class TransformerLM(Block):
         out = fn(wts, jnp.asarray(toks_np),
                  jnp.asarray(float(temperature or 1.0), jnp.float32),
                  rng)
+        if missed:
+            # jax.jit traces lazily: build + first call is the real
+            # compile wall time this signature cost (compile ledger
+            # attributes the miss — shape vs decode-config change)
+            tracing.compile_ledger("transformer_generate").record(
+                {"shape": (b, p),
+                 "static_arg": (int(max_new_tokens), sampling,
+                                key[4], key[5])},
+                time.monotonic() - t0)
         return nd.NDArray(out)
 
     def _decode_weights(self):

@@ -15,11 +15,13 @@ same on one process.  Optimizers without a functional counterpart
 (see parallel.optim.from_imperative) fall back to the eager per-param
 updater loop transparently.
 """
+
 import jax
 import jax.numpy as jnp
 
 from .. import optimizer as opt_mod
 from .. import telemetry
+from .. import tracing
 from ..model import _create_kvstore
 from ..parallel import optim as foptim
 
@@ -56,6 +58,24 @@ class Trainer:
             # overflow signal is the guard's finiteness flag, and an
             # overflow step must not reach the weights
             self._guard.policy = "skip"
+        # device-memory attribution (docs/observability.md): weakref
+        # providers so a dropped Trainer stops being counted
+        def _param_arrays(tr):
+            return [p._data._data for p in tr._params
+                    if p._data is not None]
+
+        def _opt_arrays(tr):
+            leaves = []
+            fstate = getattr(tr, "_fstate", None)
+            if fstate is not None:
+                leaves += jax.tree_util.tree_leaves(fstate)
+            states = getattr(tr._updater, "states", None)
+            if states:
+                leaves += tracing.updater_state_arrays(states)
+            return leaves
+
+        self._mem_unregister = tracing.register_param_opt_providers(
+            self, _param_arrays, _opt_arrays)
         self._kvstore_spec = kvstore
         self._kvstore = None
         self._kv_initialized = False

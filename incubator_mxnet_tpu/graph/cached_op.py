@@ -34,6 +34,7 @@ forward inside backward, in exchange for once-per-signature
 compilation instead of per-step retracing).
 """
 import threading
+import time
 from collections import OrderedDict
 
 import numpy as np
@@ -41,7 +42,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .. import autograd, random_state, telemetry
+from .. import autograd, random_state, telemetry, tracing
 from ..autograd import TapeNode
 from ..ndarray.ndarray import NDArray
 from ..utils.env import get_env
@@ -193,6 +194,12 @@ class CachedOp:
         self._hits_ctr = telemetry.counter("cachedop_cache_hits_total")
         self._misses_ctr = telemetry.counter(
             "cachedop_cache_misses_total")
+        # retrace attribution: each miss records a compile event with
+        # the signature diff vs the nearest cached entry, so a miss
+        # storm names the dimension (shape/dtype/static/train) that
+        # drives it (docs/observability.md)
+        self._ledger = tracing.compile_ledger(
+            f"cachedop:{block.name}")
         params = block.collect_params()
         self._param_names = sorted(params.keys())
         self._params = [params[n] for n in self._param_names]
@@ -229,6 +236,7 @@ class CachedOp:
         if entry is None:
             self._misses_ctr.inc()
             self.misses += 1
+            t0 = time.monotonic()
             entry = self._build_entry(template, bool(training))
             with self._lock:
                 entry = self._entries.setdefault(key, entry)
@@ -236,9 +244,16 @@ class CachedOp:
                 while self._capacity > 0 and \
                         len(self._entries) > self._capacity:
                     self._entries.popitem(last=False)
-        else:
-            self._hits_ctr.inc()
-            self.hits += 1
+            out = self._execute(entry, template, bool(training),
+                                recording)
+            # timed through the first replay: jax.jit traces lazily,
+            # so build + first call is the real compile wall time
+            self._ledger.record(
+                _signature_components(template, training),
+                time.monotonic() - t0)
+            return out
+        self._hits_ctr.inc()
+        self.hits += 1
         return self._execute(entry, template, bool(training), recording)
 
     # ------------------------------------------------------------ build
@@ -409,6 +424,30 @@ class CachedOp:
         if len(out_arrays) == 1:
             return out_arrays[0]
         return out_arrays
+
+
+def _signature_components(template, training):
+    """Flatten a call signature into the named-component dict the
+    compile ledger diffs: tensor shapes, tensor dtypes, canonical
+    static args, train flag (docs/observability.md)."""
+    shapes, dtypes, statics = [], [], []
+
+    def walk(sig):
+        tag = sig[0]
+        if tag == "nd":
+            shapes.append(sig[1])
+            dtypes.append(sig[2])
+        elif tag == "s":
+            statics.append(sig[1])
+        else:                       # L / U nested structures
+            for s in sig[1]:
+                walk(s)
+
+    for s in template.signature:
+        walk(s)
+    return {"shape": tuple(shapes), "dtype": tuple(dtypes),
+            "static_arg": tuple(statics),
+            "train_flag": bool(training)}
 
 
 def _jit_with_fallback(bwd):

@@ -219,6 +219,10 @@ class Module(BaseModule):
                 optimizer, guard=self._guard) \
                 if self._guard is not None \
                 else opt_mod.get_updater(optimizer)
+        if not use_mesh_step:
+            # device-memory attribution (docs/observability.md); the
+            # mesh path's SymbolTrainStep registers its own providers
+            self._register_memory_providers()
         self.optimizer_initialized = True
         states = getattr(self, "_preload_opt_states", None)
         if states:
@@ -239,6 +243,32 @@ class Module(BaseModule):
                     f"({exc}); resuming with freshly initialized "
                     "optimizer state", RuntimeWarning)
             self._preload_opt_states = None
+
+    def _register_memory_providers(self):
+        """Attribute this module's device buffers in the tracing
+        layer's memory gauges: bound params + eager-updater optimizer
+        state.  Weakref'd so a dropped module stops being counted;
+        idempotent per init_optimizer (providers re-register on
+        force_init, superseding via the old module's weakref dying
+        with it)."""
+        from .. import tracing
+        for unreg in getattr(self, "_mem_unregister", ()):
+            unreg()
+
+        def _param_arrays(mod):
+            if mod._exec is None:
+                return []
+            return [mod._exec.arg_dict[n]._data
+                    for n in mod._param_names
+                    if n in mod._exec.arg_dict]
+
+        def _opt_arrays(mod):
+            states = getattr(mod._updater, "states", None)
+            return tracing.updater_state_arrays(states) \
+                if states else []
+
+        self._mem_unregister = tracing.register_param_opt_providers(
+            self, _param_arrays, _opt_arrays)
 
     # ------------------------------------------------------------ mesh
     def _init_mesh_step(self):

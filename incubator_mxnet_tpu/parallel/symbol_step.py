@@ -16,11 +16,14 @@ Learning rate is a *traced scalar argument* so lr schedulers step
 without recompiling; lr_mult/wd_mult become per-leaf multiplier trees
 (ref: python/mxnet/optimizer.py _get_lr/_get_wd).
 """
+import time
+
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 
+from .. import tracing
 from ..executor import build_graph_fn, _ones_ct
 from .data_parallel import _owned_put_tree, _copy_tree
 from .mesh import make_mesh, replicated, shard_batch
@@ -90,6 +93,17 @@ class SymbolTrainStep:
         self.opt_state = self.opt.init(self.params)
         self._step = None
         self._eval = None
+        # device-memory attribution (docs/observability.md): the
+        # step owns the job's params and optimizer state on device;
+        # weakref providers so a dropped step stops being counted
+        def _param_arrays(st):
+            return list(st.params.values()) + list(st.aux.values())
+
+        def _opt_arrays(st):
+            return jax.tree_util.tree_leaves(st.opt_state)
+
+        self._mem_unregister = tracing.register_param_opt_providers(
+            self, _param_arrays, _opt_arrays)
 
     # ------------------------------------------------------------ build
     def _in_shard(self, ndim):
@@ -163,7 +177,9 @@ class SymbolTrainStep:
             rng = random_state.next_key()
         vals = {n: jnp.asarray(v) if not isinstance(v, jax.Array)
                 else v for n, v in inputs.items()}
-        if self._step is None:
+        compiled = self._step is None
+        t0 = time.monotonic()
+        if compiled:
             self._step = self._build(vals)
         vals = {n: jax.device_put(v, self._in_shard(v.ndim))
                 for n, v in vals.items()}
@@ -176,6 +192,17 @@ class SymbolTrainStep:
             self.params, self.aux, self.opt_state, vals, rng,
             jnp.asarray(lr, jnp.float32),
             jnp.asarray(poison, jnp.float32))
+        if compiled:
+            # first call = trace + compile of the whole mesh step;
+            # recorded with the batch signature so a rebuilt step
+            # (fresh Module bind / rollback) attributes what differed
+            tracing.compile_ledger("symbol_train_step").record(
+                {"shape": tuple(sorted(
+                    (n, tuple(v.shape)) for n, v in vals.items())),
+                 "dtype": tuple(sorted(
+                     (n, str(v.dtype)) for n, v in vals.items())),
+                 "train_flag": True},
+                time.monotonic() - t0)
         return outs
 
     def evaluate(self, inputs, rng=None):

@@ -18,6 +18,13 @@ import time
 __all__ = ["set_config", "set_state", "dump_profile", "pause",
            "resume", "start_xla_trace", "stop_xla_trace", "Profiler"]
 
+# synthetic thread ids ("lanes") for async request streams: chrome
+# tracing wants a tid per row, and the serving engine's per-request
+# b/e events should render on named serving rows next to the span
+# stream, not interleaved with real thread ids
+SERVE_QUEUE_LANE = 900000
+SERVE_SLOT_LANE0 = 900001
+
 
 class Profiler:
     """Singleton collecting OprExecStat-style events."""
@@ -31,6 +38,7 @@ class Profiler:
         self._dump_lock = threading.Lock()
         self._t0 = time.perf_counter()
         self._tls = threading.local()
+        self._lanes = {}    # synthetic tid -> display name ('M')
 
     # ------------------------------------------------------------ api
     def set_config(self, filename="profile.json", mode="coarse",
@@ -55,6 +63,34 @@ class Profiler:
                 "pid": os.getpid(), "tid": threading.get_ident(),
             })
 
+    def add_async_event(self, name, aid, ph, category="serving",
+                        lane=None, args=None):
+        """Record one chrome-tracing *async* event: ``ph`` is ``"b"``
+        (begin) or ``"e"`` (end), paired by ``(cat, id, name)``.
+        Async events span dispatch sites — the serving engine opens a
+        request's ``queue_wait`` in ``submit()`` and closes it at
+        admission, iterations apart — which ``X`` duration events
+        cannot express.  ``lane`` places the event on a synthetic,
+        named timeline row (see :meth:`set_lane_name`)."""
+        if ph not in ("b", "e"):
+            raise ValueError(f"async phase must be 'b'/'e' ({ph!r})")
+        ev = {"name": name, "cat": category, "ph": ph, "id": str(aid),
+              "ts": (time.perf_counter() - self._t0) * 1e6,
+              "pid": os.getpid(),
+              "tid": lane if lane is not None
+              else threading.get_ident()}
+        if args:
+            ev["args"] = dict(args)
+        with self._lock:
+            self._events.append(ev)
+
+    def set_lane_name(self, lane, name):
+        """Name a synthetic lane (tid); dumped as ``thread_name``
+        ``M`` metadata so Perfetto shows e.g. 'serve slot 0' instead
+        of a bare number."""
+        with self._lock:
+            self._lanes[int(lane)] = str(name)
+
     def _meta_events(self, events):
         """chrome-tracing metadata ('M') events: name the process and
         every thread that recorded an event, so the timeline rows
@@ -65,6 +101,8 @@ class Profiler:
         out = [{"name": "process_name", "ph": "M", "pid": pid,
                 "args": {"name": f"mxtpu rank {rank}"}}]
         names = {t.ident: t.name for t in threading.enumerate()}
+        with self._lock:
+            names.update(self._lanes)
         tids = {e["tid"] for e in events if "tid" in e}
         for tid in sorted(tids):
             out.append({"name": "thread_name", "ph": "M", "pid": pid,

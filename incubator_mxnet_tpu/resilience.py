@@ -95,6 +95,19 @@ class BadStepError(ResilienceError, ArithmeticError):
     (``except ArithmeticError``) keep working."""
 
 
+def _flight_dump(reason):
+    """Dump the flight recorder on a terminal-fault construction
+    (DivergedError / DataPipelineError).  Best-effort and strictly
+    side-channel: a tracing failure must never alter the raise, and
+    with MXTPU_TRACE_DUMP unset (default) this is a no-op — tests
+    constructing these errors stay side-effect free."""
+    try:
+        from . import tracing
+        tracing.dump_on_fault(reason)
+    except Exception:
+        pass
+
+
 class DivergedError(ResilienceError, ArithmeticError):
     """Training diverged: MXTPU_MAX_BAD_STEPS *consecutive* steps
     were non-finite, so skipping updates can no longer save the run
@@ -104,9 +117,17 @@ class DivergedError(ResilienceError, ArithmeticError):
     re-raising this, and training mains should exit with
     :data:`EXIT_CODE` (see :func:`install_diverged_exithook`) so the
     launcher restart loop can tell divergence — restart resumes from
-    the rolled-back checkpoint — from an ordinary crash."""
+    the rolled-back checkpoint — from an ordinary crash.
+
+    Constructing one dumps the flight recorder (when
+    ``MXTPU_TRACE_DUMP`` is set): the last N events before the
+    divergence are exactly the post-mortem an operator wants."""
 
     EXIT_CODE = 13
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        _flight_dump("diverged_error")
 
 
 class DataPipelineError(ResilienceError):
@@ -119,7 +140,14 @@ class DataPipelineError(ResilienceError):
     trouble where a restart rereads the same poison, the latter is
     what --max-restarts exists for.  Also a RuntimeError (via
     ResilienceError) so legacy ``except RuntimeError`` guards keep
-    working."""
+    working.
+
+    Constructing one dumps the flight recorder when
+    ``MXTPU_TRACE_DUMP`` is set (see :class:`DivergedError`)."""
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        _flight_dump("data_pipeline_error")
 
 
 # ---------------------------------------------------------------------------
@@ -554,12 +582,20 @@ class NumericGuard:
         telemetry.counter("sentinel_bad_steps_total").inc()
         telemetry.gauge("sentinel_consecutive_bad").set(
             self.consecutive_bad)
+        from . import tracing
+        tracing.trace_event(
+            "sentinel_bad_step", guard=self.name, what=what,
+            step=self.steps, consecutive=self.consecutive_bad,
+            policy=self.policy)
         msg = (f"non-finite {what} in guarded step {self.steps} "
                f"({self.name}; consecutive bad: "
                f"{self.consecutive_bad})")
         if self.max_bad_steps > 0 and \
                 self.consecutive_bad >= self.max_bad_steps:
             telemetry.counter("sentinel_divergences_total").inc()
+            tracing.trace_event(
+                "sentinel_diverged", guard=self.name,
+                step=self.steps, consecutive=self.consecutive_bad)
             raise DivergedError(
                 f"{msg}: {self.max_bad_steps} consecutive bad steps "
                 "— training diverged; roll back to the newest valid "

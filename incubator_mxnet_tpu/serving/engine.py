@@ -28,12 +28,15 @@ pool-utilization gauges.  ``MXTPU_FAULT_SPEC`` scope
 ``serve:request`` poisons the nth admission: the request is evicted
 (state ``failed``) without touching its batchmates.
 """
+import itertools
 import threading
 import time
+import weakref
+from collections import deque
 
 import numpy as np
 
-from .. import resilience, telemetry
+from .. import resilience, telemetry, tracing
 from ..utils.env import get_env
 from ..utils.log import get_logger
 from .block_table import BlockPool, BlockPoolExhausted
@@ -43,6 +46,11 @@ from .scheduler import (FAILED, FINISHED, QUEUED, Request, Scheduler,
                         SchedulingError)
 
 __all__ = ["ServingEngine"]
+
+# process-unique engine ids: request ids restart at 0 per engine, so
+# trace events carry (engine, rid) — a post-mortem dump spanning two
+# engines must never conflate their requests
+_ENGINE_IDS = itertools.count()
 
 
 def _next_pow2(n):
@@ -134,6 +142,38 @@ class ServingEngine:
         self._next_id = 0
         self._submit_lock = threading.Lock()
         self._completed = []        # retired/failed since last run()
+        # flight recorder: compile attribution for the traced
+        # builders, terminal per-request summaries for stats(), and
+        # KV-pool bytes attributed in the device-memory gauges (via
+        # a weakref so the process-wide provider table never pins a
+        # dropped engine)
+        self.engine_id = next(_ENGINE_IDS)
+        # per-engine ledger site: jit caches are per-engine, so two
+        # identically-configured engines genuinely compile twice —
+        # a shared site would attribute the second as 'duplicate'
+        self._ledger = tracing.compile_ledger(
+            f"serving_engine:{self.engine_id}")
+        self._req_summaries = deque(maxlen=1024)
+        # serving lanes are static: name them once instead of
+        # re-storing the same mapping per async event on the decode
+        # path (set_lane_name takes the profiler lock)
+        from .. import profiler
+        profiler._profiler.set_lane_name(
+            profiler.SERVE_QUEUE_LANE, "serve queue")
+        for s in range(self.max_batch):
+            profiler._profiler.set_lane_name(
+                profiler.SERVE_SLOT_LANE0 + s, f"serve slot {s}")
+        ref = weakref.ref(self)
+
+        def _kv_arrays():
+            eng = ref()
+            if eng is None:
+                return []
+            return list(eng._kpools) + list(eng._vpools)
+
+        self._mem_unregister = tracing.register_memory(
+            "kv_pools", _kv_arrays, owner=self)
+        tracing.install_signal_dump()
 
         # telemetry handles cached once (no-ops when disabled)
         self._m_requests = telemetry.counter("serving_requests_total")
@@ -173,7 +213,7 @@ class ServingEngine:
                     nd.NDArray(jnp.zeros((1, 1), jnp.int32)))
             return model._decode_weights()
 
-    def _counted_jit(self, name, fn):
+    def _counted_jit(self, name, fn, signature):
         import jax
 
         def traced(*args):
@@ -188,14 +228,31 @@ class ServingEngine:
         # PLACE instead of copying every pool array out per token —
         # the engine always rebinds self._kpools/_vpools from the
         # outputs, so the consumed buffers are never reused
-        return jax.jit(traced, donate_argnums=(1, 2))
+        jfn = jax.jit(traced, donate_argnums=(1, 2))
+
+        def called(*args):
+            # a call that ran the Python trace just compiled: record
+            # the retrace with its wall time + signature attribution
+            # (an unexpected re-trace of the decode step is exactly
+            # the storm MXTPU_COMPILE_BUDGET watches for)
+            before = self.trace_counts.get(name, 0)
+            t0 = time.monotonic()
+            out = jfn(*args)
+            if self.trace_counts.get(name, 0) > before:
+                self._ledger.record(signature, time.monotonic() - t0)
+            return out
+
+        return called
 
     def _get_step_fn(self):
         if self._step_fn is None:
             self._step_fn = self._counted_jit(
                 "decode", self.model._build_paged_step(
                     self.max_batch, self.max_blocks,
-                    self.block_size))
+                    self.block_size),
+                {"builder": "decode",
+                 "static_arg": (self.max_batch, self.max_blocks,
+                                self.block_size)})
         return self._step_fn
 
     def _get_prefill_fn(self, suffix_len):
@@ -210,7 +267,9 @@ class ServingEngine:
         if fn is None:
             fn = self._prefill_fns[bucket] = self._counted_jit(
                 f"prefill_{bucket}", self.model._build_paged_prefill(
-                    bucket, self.max_blocks, self.block_size))
+                    bucket, self.max_blocks, self.block_size),
+                {"builder": "prefill", "shape": (bucket,),
+                 "static_arg": (self.max_blocks, self.block_size)})
         return bucket, fn
 
     # ------------------------------------------------------------- API
@@ -245,6 +304,16 @@ class ServingEngine:
             req = Request(self._next_id, toks, max_new,
                           eos_id=eos_id)
             self._next_id += 1
+            # lifecycle + async events fire BEFORE the scheduler can
+            # see the request: once added, a concurrent engine
+            # thread may admit it immediately, and serve_admit must
+            # never carry a lower seq than serve_enqueue
+            tracing.trace_event("serve_enqueue", rid=req.id,
+                                engine=self.engine_id,
+                                prompt_tokens=len(toks),
+                                max_new_tokens=max_new)
+            self._prof_async("b", "request", req)
+            self._prof_async("b", "queue_wait", req)
             self._sched.add(req)
         self._m_requests.inc()
         return req
@@ -325,11 +394,24 @@ class ServingEngine:
                         "them — raise MXTPU_SERVE_NUM_BLOCKS")
                 return                          # wait for frees
             req.admit_ts = time.monotonic()
-            self._h_wait.observe(req.admit_ts - req.submit_ts)
+            # per-segment wait: a preempted request's requeue
+            # restarted the clock, so re-admission must not count
+            # its earlier prefill/decode time as queue wait
+            wait = req.admit_ts - req.enqueue_ts
+            req.queue_wait_s += wait
+            self._h_wait.observe(wait)
             self._m_hits.inc(n_cached)
             self._m_misses.inc(len(toks) - n_cached)
             req.block_ids = matched + fresh
             self._sched.place(req, slot)
+            tracing.trace_event(
+                "serve_admit", rid=req.id, engine=self.engine_id,
+                slot=slot,
+                blocks=len(req.block_ids), cached_tokens=n_cached,
+                queue_wait_s=round(wait, 6),
+                preemptions=req.preemptions)
+            self._prof_async("e", "queue_wait", req)
+            self._prof_async("b", "prefill", req)
 
             suffix = toks[n_cached:]
             bucket, fn = self._get_prefill_fn(len(suffix))
@@ -337,6 +419,7 @@ class ServingEngine:
             suf[:len(suffix)] = suffix
             row = np.zeros(self.max_blocks, np.int32)
             row[:len(req.block_ids)] = req.block_ids
+            t_pre = time.monotonic()
             with telemetry.span("serve_prefill"):
                 self._kpools, self._vpools, nxt, logits = fn(
                     self._wts, self._kpools, self._vpools,
@@ -347,6 +430,15 @@ class ServingEngine:
                 # pending hits a pathological slow path (~7x) in the
                 # runtime's donation bookkeeping
                 jax.block_until_ready(self._kpools)
+            dt_pre = time.monotonic() - t_pre
+            req.prefill_s += dt_pre
+            tracing.trace_event(
+                "serve_prefill", rid=req.id, engine=self.engine_id,
+                slot=slot,
+                suffix_tokens=len(suffix), bucket=bucket,
+                seconds=round(dt_pre, 6))
+            self._prof_async("e", "prefill", req)
+            self._prof_async("b", "decode", req)
             self._m_prefill.inc(len(suffix))
             if self.keep_logits:
                 req.logits = logits
@@ -388,6 +480,7 @@ class ServingEngine:
         generated tokens survive; re-admission re-prefills
         prompt+generated (cheap again once the prefix cache holds
         the shared blocks)."""
+        freed = len(req.block_ids)
         self._sched.clear(req)
         if req.block_ids:
             self.pool.free(req.block_ids)
@@ -396,7 +489,19 @@ class ServingEngine:
         req.state = QUEUED
         req.preemptions += 1
         self._m_preempt.inc()
+        # a preempted runner is queued again: its queue-wait clock
+        # restarts here (decomposition stays truthful across cycles)
+        req.enqueue_ts = time.monotonic()
+        tracing.trace_event(
+            "serve_preempt", rid=req.id, engine=self.engine_id,
+            generated_tokens=len(req.generated), freed_blocks=freed,
+            preemptions=req.preemptions)
+        self._prof_async("e", "decode", req)
         self._sched.push_front(req)
+        tracing.trace_event("serve_requeue", rid=req.id,
+                            engine=self.engine_id,
+                            queue_depth=len(self._sched.waiting))
+        self._prof_async("b", "queue_wait", req)
 
     def _decode_once(self, events):
         """One batched decode step + the per-iteration token read."""
@@ -439,6 +544,12 @@ class ServingEngine:
         if req.first_token_ts is None:
             req.first_token_ts = now
             self._h_ttft.observe(now - req.submit_ts)
+            tracing.trace_event(
+                "serve_first_token", rid=req.id,
+                engine=self.engine_id,
+                ttft_s=round(now - req.submit_ts, 6),
+                queue_wait_s=round(req.queue_wait_s, 6),
+                prefill_s=round(req.prefill_s, 6))
         else:
             self._h_tok.observe(now - req.last_token_ts)
         req.last_token_ts = now
@@ -457,18 +568,125 @@ class ServingEngine:
         req.state = FINISHED
         req.finish_ts = time.monotonic()
         self._completed.append(req)
+        tracing.trace_event(
+            "serve_retire", rid=req.id, engine=self.engine_id,
+            tokens_generated=len(req.generated),
+            preemptions=req.preemptions,
+            queue_wait_s=round(req.queue_wait_s, 6),
+            prefill_s=round(req.prefill_s, 6))
+        self._terminal_async(req, "decode")
+        self._req_summaries.append(self._request_summary(req))
 
     def _fail(self, req, exc):
-        """Evict a poisoned request without touching batchmates."""
+        """Evict a poisoned request without touching batchmates.
+
+        Observability parity with retirement: the queue wait is
+        recorded (an admission-time eviction would otherwise leave
+        the wait histogram blind to the request), a terminal
+        ``serve_evict`` event closes the lifecycle, and the flight
+        recorder dumps (MXTPU_TRACE_DUMP) — an eviction is a fault,
+        and the ring holds the request's whole story."""
         get_logger().warning(
             "serving: evicting request %s after injected/terminal "
             "fault: %s", req.id, exc)
+        now = time.monotonic()
+        # _fail only fires on requests popped from the queue (fresh
+        # or requeued-after-preemption), so a queue-wait segment is
+        # always open here — close it, like admission does
+        wait = now - req.enqueue_ts
+        req.queue_wait_s += wait
+        self._h_wait.observe(wait)
         self._sched.clear(req)
         if req.block_ids:
             self.pool.free(req.block_ids)
         req.block_ids = []
         req.state = FAILED
         req.error = exc
-        req.finish_ts = time.monotonic()
+        req.finish_ts = now
         self._m_evict.inc()
         self._completed.append(req)
+        tracing.trace_event(
+            "serve_evict", rid=req.id, engine=self.engine_id,
+            error=str(exc),
+            tokens_generated=len(req.generated),
+            queue_wait_s=round(req.queue_wait_s, 6),
+            preemptions=req.preemptions)
+        self._terminal_async(req, "queue_wait")
+        self._req_summaries.append(self._request_summary(req))
+        tracing.dump_on_fault("serving_eviction")
+
+    # -------------------------------------------------- observability
+    def _prof_async(self, ph, name, req):
+        """Emit one chrome-tracing async (b/e) event for a request
+        phase when the profiler is running; each request id is an
+        async track, placed on a named serving lane.  Lane choice is
+        a function of the PHASE, not of ``req.slot`` at emission
+        time — slot is nulled by ``Scheduler.clear`` before terminal
+        events fire, and every phase of one request must land on one
+        lane: ``request``/``queue_wait`` live on the queue lane,
+        compute phases (``prefill``/``decode``) on the slot of the
+        request's FIRST admission (``last_slot``, pinned in
+        ``Scheduler.place`` and never cleared — re-admission into a
+        different slot must not split the track)."""
+        from .. import profiler
+        prof = profiler._profiler
+        if not prof.running:
+            return
+        if name in ("request", "queue_wait") or req.last_slot is None:
+            lane = profiler.SERVE_QUEUE_LANE
+        else:
+            lane = profiler.SERVE_SLOT_LANE0 + req.last_slot
+        prof.add_async_event(name,
+                             f"req{self.engine_id}.{req.id}", ph,
+                             category="serving", lane=lane)
+
+    def _terminal_async(self, req, open_phase):
+        """Close a request's open async phases at its terminal
+        transition.  ``open_phase`` is the phase still open at that
+        point: always ``decode`` for retirement (opened at the last
+        admission), always ``queue_wait`` for eviction — ``_fail``
+        only fires on requests popped from the queue, including
+        preempted ones whose requeue re-opened the wait."""
+        self._prof_async("e", open_phase, req)
+        self._prof_async("e", "request", req)
+
+    @staticmethod
+    def _request_summary(req):
+        """One request's TTFT decomposition for :meth:`stats`."""
+        ttft = (req.first_token_ts - req.submit_ts
+                if req.first_token_ts is not None else None)
+        decode = (req.last_token_ts - req.first_token_ts
+                  if req.first_token_ts is not None
+                  and req.last_token_ts is not None else None)
+        return {
+            "id": req.id, "state": req.state,
+            "prompt_tokens": len(req.prompt),
+            "tokens_generated": len(req.generated),
+            "preemptions": req.preemptions,
+            "queue_wait_s": round(req.queue_wait_s, 6),
+            "prefill_s": round(req.prefill_s, 6),
+            "ttft_s": round(ttft, 6) if ttft is not None else None,
+            "decode_s": (round(decode, 6)
+                         if decode is not None else None),
+            "error": (str(req.error)
+                      if req.error is not None else None),
+        }
+
+    def stats(self):
+        """Engine observability snapshot: per-request lifecycle
+        summaries (terminal requests from the bounded summary ring,
+        live ones in flight), trace/compile counts, and pool state.
+        Host-side bookkeeping only — no device access; safe to call
+        from a monitoring thread while the engine runs
+        (tracing.safe_list absorbs concurrent deque mutation)."""
+        live = [self._request_summary(r)
+                for r in tracing.safe_list(self._sched.waiting)
+                + self._sched.running()]
+        return {
+            "requests": tracing.safe_list(self._req_summaries),
+            "live": live,
+            "trace_counts": dict(self.trace_counts),
+            "batch_occupancy":
+                self._sched.n_running() / self.max_batch,
+            "pool_utilization": self.pool.utilization(),
+        }

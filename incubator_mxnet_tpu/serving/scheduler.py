@@ -53,7 +53,8 @@ class Request:
                  "generated", "block_ids", "n_past", "slot",
                  "admit_seq", "preemptions", "error", "logits",
                  "submit_ts", "admit_ts", "first_token_ts",
-                 "last_token_ts", "finish_ts")
+                 "last_token_ts", "finish_ts", "enqueue_ts",
+                 "queue_wait_s", "prefill_s", "last_slot")
 
     def __init__(self, req_id, prompt, max_new_tokens, eos_id=None):
         self.id = req_id
@@ -74,6 +75,17 @@ class Request:
         self.first_token_ts = None
         self.last_token_ts = None
         self.finish_ts = None
+        # flight-recorder decomposition: time spent QUEUED (accrues
+        # again after every preemption — enqueue_ts re-stamps) and
+        # cumulative suffix-prefill wall time (re-prefills included)
+        self.enqueue_ts = self.submit_ts
+        self.queue_wait_s = 0.0
+        self.prefill_s = 0.0
+        # pinned at FIRST admission and never cleared: the profiler
+        # places every phase of one request on one lane, so terminal
+        # events (after clear() nulls .slot) and re-admissions into a
+        # different slot keep rendering on the same track
+        self.last_slot = None
 
     @property
     def done(self):
@@ -128,6 +140,8 @@ class Scheduler:
         assert self.slots[slot] is None
         self.slots[slot] = req
         req.slot = slot
+        if req.last_slot is None:
+            req.last_slot = slot    # lane pin: first admission wins
         req.state = RUNNING
         req.admit_seq = self._admit_counter
         self._admit_counter += 1

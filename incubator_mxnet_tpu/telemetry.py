@@ -518,9 +518,20 @@ def start_emitter(path=None, interval=None):
 def maybe_start_emitter():
     """Fit-loop hook: start the emitter iff telemetry is on and
     ``MXTPU_TELEMETRY_FILE`` is set.  Steady-state cost when already
-    running (or disabled): an env read and a lock-free check."""
+    running (or disabled): an env read and a lock-free check.
+
+    Also the training-side hook for the flight recorder's signal
+    dump (no-op unless ``MXTPU_TRACE_DUMP`` is set): fit loops,
+    gluon Trainers, and dist.init all pass through here, so a hung
+    training worker killed by the launcher leaves a post-mortem just
+    like a serving engine does."""
     if not enabled():
         return None
+    try:
+        from . import tracing
+        tracing.install_signal_dump()
+    except Exception:
+        pass
     cur = _EMITTER["obj"]
     if cur is not None and cur.running and cur.path == _emitter_path():
         return cur
@@ -545,7 +556,18 @@ def heartbeat_payload():
     heartbeat file by ``resilience._beat`` (line 1 stays the bare
     timestamp, so mtime-based monitors and old parsers are
     untouched).  ``tools/launch.py`` reads these to aggregate ranks.
-    Empty string when telemetry is disabled."""
+    Empty string when telemetry is disabled.
+
+    Each beat first refreshes the tracing layer's memory gauges
+    (host RSS + device live/peak bytes attributed to params /
+    optimizer / KV pools / workspace — metadata reads only, no
+    device syncs), so per-rank memory and the compile-event counters
+    ride the same channel launch.py already monitors."""
     if not enabled():
         return ""
+    try:
+        from . import tracing
+        tracing.update_memory_gauges()
+    except Exception:
+        pass    # memory sampling must never silence the heartbeat
     return json.dumps(_REGISTRY.snapshot(), sort_keys=True)

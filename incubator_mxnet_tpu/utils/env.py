@@ -198,6 +198,23 @@ register_env("MXTPU_STATUS_INTERVAL", float, 30.0,
              "status lines (built from per-worker heartbeat "
              "telemetry snapshots); 0 disables")
 
+# Flight recorder / tracing (tracing.py; docs/observability.md).
+register_env("MXTPU_TRACE_BUFFER", int, 4096,
+             "flight-recorder ring-buffer capacity (structured "
+             "events retained for post-mortem dumps); older events "
+             "are evicted and counted as dropped")
+register_env("MXTPU_TRACE_DUMP", str, "",
+             "path the flight recorder dumps to (atomic JSONL) on "
+             "DivergedError / DataPipelineError / serving eviction "
+             "faults / SIGTERM+SIGUSR1; empty (default) disables "
+             "automatic fault dumps (tracing.dump(path) always "
+             "works)")
+register_env("MXTPU_COMPILE_BUDGET", float, 0.0,
+             "retrace-storm watchdog: warn loudly when cumulative "
+             "compile wall-time across all ledger sites crosses "
+             "this many seconds (and again at every doubling); "
+             "0 disables")
+
 # Data-pipeline resilience (io/, gluon/data/; docs/data_pipeline.md).
 register_env("MXTPU_DATA_TIMEOUT", float, 600.0,
              "wall-clock deadline (s) on input-pipeline queue waits; "

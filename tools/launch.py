@@ -217,12 +217,33 @@ def _collect_snapshots(hb_files):
     return snaps
 
 
+def _rank_memory(snap):
+    """One rank's memory footprint in bytes from its snapshot gauges:
+    device live bytes when the backend reports them, host RSS
+    otherwise (CPU-only workers still show their real footprint)."""
+    gauges = snap.get("gauges") or {}
+    dev = gauges.get("device_live_bytes", 0.0) or 0.0
+    return dev if dev > 0 else (gauges.get("host_rss_bytes", 0.0)
+                                or 0.0)
+
+
+def _fmt_bytes(n):
+    if n >= 1 << 30:
+        return f"{n / (1 << 30):.1f}GB"
+    if n >= 1 << 20:
+        return f"{n / (1 << 20):.0f}MB"
+    return f"{n / (1 << 10):.0f}KB"
+
+
 def _aggregate_telemetry(snaps):
     """Combine per-rank snapshots: counters sum across ranks,
     throughput sums, per-rank step counts identify the straggler
-    (the rank whose step counter trails the fleet)."""
+    (the rank whose step counter trails the fleet), per-rank memory
+    (device live bytes, falling back to host RSS) identifies the
+    max-memory rank — the one that OOMs first."""
     agg = {"ranks": sorted(snaps), "counters": {}, "throughput": 0.0,
-           "steps": {}, "straggler": None}
+           "steps": {}, "straggler": None, "memory": {},
+           "compiles": {}, "max_memory": None}
     for rank, snap in snaps.items():
         for name, v in (snap.get("counters") or {}).items():
             agg["counters"][name] = agg["counters"].get(name, 0) + v
@@ -231,11 +252,21 @@ def _aggregate_telemetry(snaps):
                                         0.0)
         agg["steps"][rank] = (snap.get("counters") or {}).get(
             "train_steps_total", 0)
+        mem = _rank_memory(snap)
+        if mem > 0:
+            agg["memory"][rank] = mem
+        compiles = (snap.get("counters") or {}).get(
+            "compile_events_total", 0)
+        if compiles:
+            agg["compiles"][rank] = compiles
     if len(agg["steps"]) > 1:
         lo = min(agg["steps"], key=agg["steps"].get)
         hi = max(agg["steps"].values())
         if agg["steps"][lo] < hi:
             agg["straggler"] = (lo, agg["steps"][lo], hi)
+    if agg["memory"]:
+        hi_rank = max(agg["memory"], key=agg["memory"].get)
+        agg["max_memory"] = (hi_rank, agg["memory"][hi_rank])
     return agg
 
 
@@ -252,6 +283,12 @@ def _format_status(agg):
     if agg["straggler"] is not None:
         rank, at, hi = agg["straggler"]
         parts.append(f"straggler: rank {rank} at step {at}/{hi}")
+    if agg.get("max_memory") is not None:
+        rank, mem = agg["max_memory"]
+        parts.append(f"mem: max rank {rank} at {_fmt_bytes(mem)}")
+    if agg.get("compiles"):
+        parts.append(
+            f"compiles={sum(agg['compiles'].values())}")
     return "launch.py: status: " + " | ".join(parts)
 
 
@@ -266,10 +303,14 @@ def _format_report(snaps):
     for rank in agg["ranks"]:
         gauges = snaps[rank].get("gauges") or {}
         tp = gauges.get("throughput_samples_per_sec")
+        mem = agg["memory"].get(rank)
+        compiles = agg["compiles"].get(rank)
         lines.append(
             f"launch.py:   rank {rank}: steps="
             f"{agg['steps'].get(rank, 0)}"
-            + (f" {tp:.1f} samples/s" if tp else ""))
+            + (f" {tp:.1f} samples/s" if tp else "")
+            + (f" mem={_fmt_bytes(mem)}" if mem else "")
+            + (f" compiles={compiles}" if compiles else ""))
     nonzero = {n: v for n, v in sorted(agg["counters"].items()) if v}
     if nonzero:
         lines.append("launch.py:   counters (summed over ranks):")
@@ -279,6 +320,10 @@ def _format_report(snaps):
         rank, at, hi = agg["straggler"]
         lines.append(f"launch.py:   straggler: rank {rank} finished "
                      f"at step {at} of {hi}")
+    if agg.get("max_memory") is not None:
+        rank, mem = agg["max_memory"]
+        lines.append(f"launch.py:   max memory: rank {rank} at "
+                     f"{_fmt_bytes(mem)}")
     lines.append("launch.py: -----------------------")
     return "\n".join(lines)
 
