@@ -1041,6 +1041,246 @@ def _bench_pipeline(dev, platform):
     }))
 
 
+def _bench_data_service(dev, platform):
+    """Sharded multi-process input service (docs/data_service.md):
+    img/s at 1/2/4 decode worker processes vs the single-process
+    native and PIL baselines, deterministic-mode bit-identity,
+    mid-epoch resume exactness, and SIGKILL-worker recovery timing.
+    Run with MXTPU_BENCH_MODEL=data_service; writes BENCH_r10.json.
+
+    Methodology notes baked into the artifact: the ISSUE-10 baseline
+    (766 img/s) was measured on the round-4 ONE-core host; absolute
+    scaling here is bounded by this host's core count (`ncores`), so
+    scaling efficiency is reported against the core-bounded ideal
+    min(W, ncores), and each config is measured in interleaved
+    rounds (median + best reported) because this host shows heavy
+    run-to-run CPU-availability noise."""
+    import signal
+    import tempfile
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.data_service import DataServiceIter
+
+    ncores = os.cpu_count() or 1
+    n_img = int(os.environ.get("MXTPU_BENCH_DS_IMGS", "1024"))
+    reps = int(os.environ.get("MXTPU_BENCH_DS_REPS", "3"))
+    ISSUE_BASELINE = 766.0     # r4 single-process native (PERF.md)
+    shape = (3, 224, 224)
+
+    with tempfile.TemporaryDirectory() as td:
+        prefix = os.path.join(td, "synth")
+        _stage(f"generating {n_img} JPEGs", "ds")
+        _make_synthetic_rec(prefix, n_img)
+
+        def single_iter(threads):
+            return mx.io.ImageRecordIter(
+                path_imgrec=prefix + ".rec", data_shape=shape,
+                batch_size=BATCH, shuffle=False,
+                preprocess_threads=threads, round_batch=True)
+
+        def single_rate(threads, native=True):
+            old = os.environ.get("MXTPU_NATIVE_DECODE")
+            if not native:
+                os.environ["MXTPU_NATIVE_DECODE"] = "0"
+            try:
+                it = single_iter(threads)
+                t0 = time.perf_counter()
+                n = sum(b.data[0].shape[0] - b.pad for b in it)
+                return n / (time.perf_counter() - t0)
+            finally:
+                if not native:
+                    if old is None:
+                        os.environ.pop("MXTPU_NATIVE_DECODE", None)
+                    else:
+                        os.environ["MXTPU_NATIVE_DECODE"] = old
+
+        def service_rate(W):
+            svc = DataServiceIter(
+                path_imgrec=prefix + ".rec", data_shape=shape,
+                batch_size=BATCH, num_workers=W,
+                preprocess_threads=1, round_batch=True)
+            try:
+                sum(1 for _ in svc)       # warm epoch (spawn, faults)
+                svc.reset()
+                t0 = time.perf_counter()
+                n = sum(b.data[0].shape[0] - b.pad for b in svc)
+                return n / (time.perf_counter() - t0)
+            finally:
+                svc.close()
+
+        # interleaved rounds decorrelate host-availability noise
+        # from the config under test
+        workers = (1, 2, 4)
+        # on a 1-core host ("single", 1) and ("single", ncores) are
+        # the same dict key — measure each distinct config once
+        single_cfgs = (1,) if ncores == 1 else (1, ncores)
+        samples = {("svc", w): [] for w in workers}
+        for c in single_cfgs:
+            samples[("single", c)] = []
+        samples[("pil", 4)] = []
+        for r in range(reps):
+            _stage(f"measurement round {r + 1}/{reps}", "ds")
+            samples[("pil", 4)].append(single_rate(4, native=False))
+            for c in single_cfgs:
+                samples[("single", c)].append(single_rate(c))
+            for w in workers:
+                samples[("svc", w)].append(service_rate(w))
+
+        def med(xs):
+            return float(np.median(xs))
+
+        svc_best = {w: max(samples[("svc", w)]) for w in workers}
+        svc_med = {w: med(samples[("svc", w)]) for w in workers}
+
+        # ---- correctness: bit-identity + resume + kill recovery
+        _stage("bit-identity / resume / kill-recovery", "ds")
+        it = single_iter(2)
+        ref = [(b.data[0].asnumpy(), b.label[0].asnumpy(), b.pad)
+               for b in it]
+
+        def batches_equal(got):
+            return len(got) == len(ref) and all(
+                p == rp and np.array_equal(d, rd)
+                and np.array_equal(l, rl)
+                for (d, l, p), (rd, rl, rp) in zip(got, ref))
+
+        with DataServiceIter(
+                path_imgrec=prefix + ".rec", data_shape=shape,
+                batch_size=BATCH, num_workers=2,
+                preprocess_threads=1, round_batch=True) as svc:
+            got = [(b.data[0].asnumpy(), b.label[0].asnumpy(), b.pad)
+                   for b in svc]
+            bit_identical = batches_equal(got)
+            # resume: 5 delivered batches, snapshot, drain the rest
+            svc.reset()
+            for _ in range(5):
+                svc.next()
+            state = svc.state_dict()
+            tail = [(b.data[0].asnumpy(), b.pad) for b in svc]
+        with DataServiceIter(
+                path_imgrec=prefix + ".rec", data_shape=shape,
+                batch_size=BATCH, num_workers=2,
+                preprocess_threads=1, round_batch=True) as svc:
+            svc.load_state_dict(state)
+            svc.reset()
+            tail2 = [(b.data[0].asnumpy(), b.pad) for b in svc]
+            resume_exact = len(tail) == len(tail2) and all(
+                p == rp and np.array_equal(d, rd)
+                for (d, p), (rd, rp) in zip(tail, tail2))
+
+        import warnings as _warnings
+        with DataServiceIter(
+                path_imgrec=prefix + ".rec", data_shape=shape,
+                batch_size=BATCH, num_workers=2,
+                preprocess_threads=1, ring_depth=1,
+                round_batch=True) as svc:
+            got = [(svc.next().data[0].asnumpy(), None, 0)]
+            os.kill(svc._procs[1].pid, signal.SIGKILL)
+            # the killed worker usually has a batch already staged in
+            # its ring, so the first post-kill next() can just drain
+            # it — recovery is the next() whose consume notices the
+            # dead producer, respawns, and waits for the restarted
+            # worker's first batch: the one that moves _restarts
+            kill_recovery_s = None
+            with _warnings.catch_warnings():
+                _warnings.simplefilter("ignore")
+                try:
+                    while True:
+                        t0 = time.perf_counter()
+                        b = svc.next()
+                        dt = time.perf_counter() - t0
+                        if kill_recovery_s is None and svc._restarts:
+                            kill_recovery_s = dt
+                        got.append((b.data[0].asnumpy(), None, 0))
+                except StopIteration:
+                    pass
+            kill_identical = len(got) == len(ref) and all(
+                np.array_equal(d, rd)
+                for (d, _, _), (rd, _, _) in zip(got, ref))
+            restarts = svc._restarts
+        shm_clean = not [f for f in os.listdir("/dev/shm")
+                         if f.startswith("mxtpu_ds")]
+
+    ideal = {w: min(w, ncores) for w in workers}
+    artifact = {
+        "metric": "data_service_input_throughput",
+        "platform": platform,
+        "host": {"ncores": ncores, "n_images": n_img,
+                 "batch": BATCH, "rounds": reps,
+                 "note": ("heavy run-to-run CPU-availability noise "
+                          "on this host (co-tenant steal): configs "
+                          "measured in interleaved rounds, median "
+                          "and best reported; acceptance uses best")},
+        "issue_baseline_img_s": ISSUE_BASELINE,
+        "issue_baseline_note": ("766 img/s was the r4 single-process "
+                                "native ceiling measured on a ONE-"
+                                "core host (PERF.md round 4)"),
+        "baselines": {
+            "pil_4threads_img_s": round(med(samples[("pil", 4)]), 1),
+            "native_1thread_img_s": round(
+                med(samples[("single", 1)]), 1),
+            **({f"native_{ncores}threads_img_s": round(
+                med(samples[("single", ncores)]), 1),
+                "host_thread_scaling_1_to_2": round(
+                    med(samples[("single", ncores)])
+                    / med(samples[("single", 1)]), 2)}
+               if ncores > 1 else {}),
+            # the strongest single-process number this host produced
+            # across all rounds: the service must beat THIS, not
+            # just the one-core-host 766 figure
+            "single_process_best_img_s": round(max(
+                max(samples[("single", c)])
+                for c in single_cfgs), 1),
+        },
+        "service": {
+            str(w): {
+                "img_s_median": round(svc_med[w], 1),
+                "img_s_best": round(svc_best[w], 1),
+                "vs_issue_baseline": round(
+                    svc_best[w] / ISSUE_BASELINE, 2),
+                "ideal_cores": ideal[w],
+                "scaling_efficiency_vs_core_ideal": round(
+                    (svc_best[w] / svc_best[1]) / ideal[w], 2),
+            } for w in workers},
+        "correctness": {
+            "bit_identical_deterministic": bit_identical,
+            "resume_exact": resume_exact,
+            "kill_recovery_s": round(kill_recovery_s, 2),
+            "kill_epoch_bit_identical": kill_identical,
+            "worker_restarts": restarts,
+            "no_orphan_shm": shm_clean,
+        },
+        "acceptance": {
+            "ge_2x_over_766": max(svc_best.values())
+            >= 2 * ISSUE_BASELINE,
+            "beats_same_host_single_process": max(svc_best.values())
+            >= max(max(samples[("single", c)]) for c in single_cfgs),
+            "scaling_note": (f"absolute 1->4 scaling is bounded by "
+                             f"ncores={ncores} on this host (in-"
+                             "process native thread scaling 1->2 is "
+                             "equally bounded — see host_thread_"
+                             "scaling_1_to_2); efficiency is vs "
+                             "min(W, ncores)"),
+        },
+    }
+    out = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_r10.json")
+    with open(out, "w") as f:
+        f.write(json.dumps(artifact, indent=2) + "\n")
+    print(json.dumps({
+        "metric": "data_service_input_throughput",
+        "value": round(max(svc_best.values()), 1),
+        "unit": "img/sec",
+        "vs_766_single_process": round(
+            max(svc_best.values()) / ISSUE_BASELINE, 2),
+        "bit_identical": bit_identical,
+        "resume_exact": resume_exact,
+        "kill_recovery_s": round(kill_recovery_s, 2),
+        "platform": platform,
+        "artifact": "BENCH_r10.json",
+    }))
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -1069,6 +1309,9 @@ def main():
         return
     if os.environ.get("MXTPU_BENCH_MODEL") == "tracing":
         _bench_tracing(dev, platform)
+        return
+    if os.environ.get("MXTPU_BENCH_MODEL") == "data_service":
+        _bench_data_service(dev, platform)
         return
 
     import incubator_mxnet_tpu as mx

@@ -44,6 +44,19 @@ DATA_QUEUE_DIRS = (
     # serving request queues: a wedged submitter must never hang the
     # scheduler loop
     "incubator_mxnet_tpu/serving/",
+    # data-service shared-memory rings: every consumer wait must be
+    # deadline-aware (ring.get) or it hangs on a SIGKILLed worker
+    "incubator_mxnet_tpu/data_service/",
+)
+
+# In the data-service ring modules the blocking primitive is a
+# multiprocessing semaphore, not a queue: a bare ``.acquire()`` with
+# no timeout is the same eternal-block hazard as an unbounded
+# ``queue.get()`` (a SIGKILLed producer never releases), so every
+# acquire must pass a timeout and poll (ring.get / _acquire_free).
+# Deliberate exceptions carry `# deadline-ok: <why>` on the line.
+SEM_ACQUIRE_DIRS = (
+    "incubator_mxnet_tpu/data_service/",
 )
 
 # Guarded training hot paths (step sentinel,
@@ -330,6 +343,42 @@ def check_file(path):
                 "checkpoint-writing module — use resilience."
                 "atomic_save/atomic_write_bytes so saves are "
                 "atomic and checksummed")
+        if any(d in posix for d in SEM_ACQUIRE_DIRS) \
+                and isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ("acquire", "wait"):
+            # unbounded means NO finite timeout — acquire(True),
+            # acquire(block=True) and wait(timeout=None) block just
+            # as eternally as the zero-arg forms.  Non-blocking
+            # acquire(False) is exempt.
+            kws = {k.arg: k.value for k in node.keywords if k.arg}
+            if node.func.attr == "acquire":
+                block = kws.get("block", kws.get("blocking"))
+                if block is None and node.args:
+                    block = node.args[0]
+                timeout = kws.get("timeout")
+                if timeout is None and len(node.args) > 1:
+                    timeout = node.args[1]
+            else:
+                block = None
+                timeout = kws.get("timeout")
+                if timeout is None and node.args:
+                    timeout = node.args[0]
+            nonblocking = isinstance(block, ast.Constant) \
+                and block.value is False
+            unbounded = timeout is None or (
+                isinstance(timeout, ast.Constant)
+                and timeout.value is None)
+            line = src.splitlines()[node.lineno - 1] \
+                if node.lineno - 1 < len(src.splitlines()) else ""
+            if unbounded and not nonblocking \
+                    and "deadline-ok" not in line:
+                problems.append(
+                    f"{path}:{node.lineno}: unbounded .{node.func.attr}"
+                    "() in a data-service ring module — a SIGKILLed "
+                    "producer never releases; pass a finite timeout "
+                    "and poll (see ring.get), or annotate the line "
+                    "with '# deadline-ok: <why>'")
         if in_data_queue_module and isinstance(node, ast.Call) \
                 and isinstance(node.func, ast.Attribute) \
                 and node.func.attr == "get" \
@@ -457,6 +506,23 @@ def check_metric_catalog(files):
         return []
     catalog = docs.read_text()
     name_re = re.compile(r"^[a-z][a-z0-9_]*$")
+    # catalogued name tokens, for prefix-matching dynamically-built
+    # names (e.g. `data_service_shard<N>_img_per_sec`)
+    catalog_tokens = set(re.findall(r"`([a-zA-Z0-9_<>]+)`", catalog))
+
+    def _dynamic_prefix(arg):
+        """Leading literal text of a %-formatted or f-string metric
+        name, or None when the arg is not such an expression."""
+        if isinstance(arg, ast.BinOp) and isinstance(arg.op, ast.Mod) \
+                and isinstance(arg.left, ast.Constant) \
+                and isinstance(arg.left.value, str):
+            return arg.left.value.split("%")[0]
+        if isinstance(arg, ast.JoinedStr) and arg.values \
+                and isinstance(arg.values[0], ast.Constant) \
+                and isinstance(arg.values[0].value, str):
+            return arg.values[0].value
+        return None
+
     problems = []
     for path in files:
         posix = path.as_posix()
@@ -470,13 +536,28 @@ def check_metric_catalog(files):
         except SyntaxError:
             continue        # reported by check_file
         for node in ast.walk(tree):
-            if not (isinstance(node, ast.Call) and node.args
-                    and isinstance(node.args[0], ast.Constant)
-                    and isinstance(node.args[0].value, str)):
+            if not (isinstance(node, ast.Call) and node.args):
                 continue
             fn = node.func
             fname = fn.id if isinstance(fn, ast.Name) else \
                 fn.attr if isinstance(fn, ast.Attribute) else ""
+            if fname in METRIC_FACTORIES | TRACE_EVENT_FACTORIES:
+                # dynamically-built names (per-shard gauges): the
+                # literal prefix must match a catalogued pattern
+                # token, so even templated families stay documented
+                prefix = _dynamic_prefix(node.args[0])
+                if prefix is not None and len(prefix) >= 4 and \
+                        not any(t.startswith(prefix)
+                                for t in catalog_tokens):
+                    problems.append(
+                        f"{path}:{node.lineno}: dynamically-named "
+                        f"metric/event starting {prefix!r} has no "
+                        "catalogued pattern in docs/observability.md "
+                        "(declare it like `" + prefix + "<N>_...`)")
+                    continue
+            if not (isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
             name = node.args[0].value
             if fname in METRIC_FACTORIES and name_re.match(name) \
                     and f"`{name}`" not in catalog:

@@ -1,0 +1,12 @@
+"""Sharded multi-process input data service (docs/data_service.md).
+
+``DataServiceIter`` shards a RecordIO dataset across N decode worker
+processes (native ``src/imgdec`` decoder, own thread pools) and
+streams finished batches through bounded shared-memory rings — the
+answer to PERF.md's measured single-process input ceiling.
+"""
+from .ring import ShmBatchRing
+from .service import DataServiceIter
+from .worker import build_decode_spec
+
+__all__ = ["DataServiceIter", "ShmBatchRing", "build_decode_spec"]

@@ -47,7 +47,9 @@ def _native_lib():
     # a stale cached build simply not found, instead of relying on a
     # same-path reload (glibc dedups dlopen by pathname, so re-loading
     # a rebuilt .so at the SAME path returns the old mapping)
-    so = os.path.join(here, "lib", "libmxtpu_imgdec.v2.so")
+    # v3: fork-safe thread pool (pthread_atfork re-arm) for the
+    # multi-process data service's forked decode workers
+    so = os.path.join(here, "lib", "libmxtpu_imgdec.v3.so")
     src = os.path.join(os.path.dirname(here), "src", "imgdec",
                        "imgdec.cc")
     if not os.path.exists(so):

@@ -20,6 +20,7 @@ import warnings
 from .. import recordio as rio
 from ..io.io import (DataBatch, DataDesc, DataIter, _bounded_get,
                      _stop_aware_put)
+from ..io.sharding import shard_keys
 from ..ndarray.ndarray import array as nd_array
 from ..resilience import DataPipelineError, inject
 from ..utils.env import get_env
@@ -90,8 +91,11 @@ class ImageRecordIter(DataIter):
         if os.path.exists(idx_path):
             self._rec = rio.MXIndexedRecordIO(idx_path, path_imgrec,
                                               "r")
-            keys = list(self._rec.keys)[part_index::num_parts]
-            self._keys = keys
+            # contiguous record-boundary partition (exactly-once
+            # coverage across parts; io/sharding.py — the floor
+            # arithmetic keeps part edges exact for every N/P)
+            self._keys = shard_keys(list(self._rec.keys), num_parts,
+                                    part_index)
         else:
             self._rec = rio.MXRecordIO(path_imgrec, "r")
             self._keys = None

@@ -6,8 +6,12 @@ buffers, with async device transfer riding JAX dispatch.
 """
 from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
                  PrefetchingIter, DevicePrefetchIter, CSVIter,
-                 MNISTIter, ImageRecordIter, LibSVMIter)
+                 MNISTIter, ImageRecordIter, LibSVMIter,
+                 DataServiceIter)
+from .sharding import shard_keys, shard_range, assigned_batches
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter",
            "ResizeIter", "PrefetchingIter", "DevicePrefetchIter",
-           "CSVIter", "MNISTIter", "ImageRecordIter", "LibSVMIter"]
+           "CSVIter", "MNISTIter", "ImageRecordIter", "LibSVMIter",
+           "DataServiceIter", "shard_keys", "shard_range",
+           "assigned_batches"]

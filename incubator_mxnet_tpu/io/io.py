@@ -28,7 +28,7 @@ from ..resilience import DataPipelineError, data_timeout, inject
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter",
            "ResizeIter", "PrefetchingIter", "DevicePrefetchIter",
            "CSVIter", "MNISTIter",
-           "LibSVMIter", "ImageRecordIter"]
+           "LibSVMIter", "ImageRecordIter", "DataServiceIter"]
 
 # prefetch consumers poll in short slices so a dead producer thread
 # is noticed within one slice, not only at the full data timeout
@@ -608,14 +608,23 @@ class DevicePrefetchIter(DataIter):
         train = mx.io.DevicePrefetchIter(
             mx.io.ImageRecordIter(...), ctx=mx.tpu(0))
         module.fit(train, ...)
+
+    ``depth`` bounds the in-flight staged batches (HBM use stays at
+    ``depth × batch_bytes``); ``None`` reads
+    ``MXTPU_DEVICE_PREFETCH_DEPTH`` (default 2) so a fast
+    multi-process producer (``DataServiceIter``) can deepen the
+    device stage without code changes (docs/data_pipeline.md).
     """
 
-    def __init__(self, data_iter, ctx=None, depth=2):
+    def __init__(self, data_iter, ctx=None, depth=None):
         super().__init__(data_iter.batch_size)
         from ..context import default_context
+        from ..utils.env import get_env
         self._iter = data_iter
         self._ctx = ctx or default_context()
-        self._depth = depth
+        if depth is None:
+            depth = get_env("MXTPU_DEVICE_PREFETCH_DEPTH")
+        self._depth = max(1, int(depth))
         self._delivered = 0
         self._pending_resume = None
         self._capture_epoch_state()
@@ -864,4 +873,12 @@ def ImageRecordIter(*args, **kwargs):
     """RecordIO image pipeline (ref: iter_image_recordio_2.cc).
     Provided by the image/recordio milestone."""
     from ..image.record_iter import ImageRecordIter as _Impl
+    return _Impl(*args, **kwargs)
+
+
+def DataServiceIter(*args, **kwargs):
+    """Sharded multi-process input data service: N decode worker
+    processes feeding bounded shared-memory rings
+    (docs/data_service.md).  Provided by the data_service package."""
+    from ..data_service.service import DataServiceIter as _Impl
     return _Impl(*args, **kwargs)

@@ -23,8 +23,10 @@ is the one place those defenses live:
   file so the launcher can tell *hung* from *crashed* workers.
 - Deterministic fault injection via ``MXTPU_FAULT_SPEC`` so every
   path above is testable on CPU: ``scope:op:nth:kind`` (e.g.
-  ``collective:allreduce:2:hang``, ``checkpoint:save:1:truncate``);
-  see docs/resilience.md for the grammar.
+  ``collective:allreduce:2:hang``, ``checkpoint:save:1:truncate``;
+  the data service's decode workers and rings inject under
+  ``data_service:worker`` / ``data_service:ring``); see
+  docs/resilience.md for the grammar.
 
 Everything here is stdlib-only and import-light so dist workers can
 use it before jax is up.

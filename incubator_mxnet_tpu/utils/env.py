@@ -234,3 +234,20 @@ register_env("MXTPU_DL_DEAD_GRACE", float, 60.0,
              "seconds a multiprocess DataLoader waits for a dead "
              "worker's in-flight batch before declaring it lost and "
              "re-dispatching (MXTPU_DATA_WORKER_RESTARTS budget)")
+
+# Sharded multi-process data service (data_service/;
+# docs/data_service.md).
+register_env("MXTPU_DATA_WORKERS", int, 2,
+             "decode worker processes a DataServiceIter spawns when "
+             "num_workers is not given; tools/launch.py "
+             "--data-workers exports this to every rank")
+register_env("MXTPU_DATA_RING_DEPTH", int, 4,
+             "batches each data-service shard stages in its bounded "
+             "shared-memory ring; backpressure blocks the worker — "
+             "never grows memory — once the ring is full (host "
+             "memory is num_workers * depth * batch_bytes)")
+register_env("MXTPU_DEVICE_PREFETCH_DEPTH", int, 2,
+             "in-flight device batches a DevicePrefetchIter stages "
+             "when its depth argument is not given (HBM use is "
+             "depth * batch_bytes); deepen it when a multi-process "
+             "producer outruns the depth-2 default")

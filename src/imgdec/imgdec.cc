@@ -15,6 +15,7 @@
 #include <cstdio>   // jpeglib.h needs FILE declared first
 
 #include <jpeglib.h>
+#include <pthread.h>
 
 #include <algorithm>
 #include <atomic>
@@ -25,6 +26,7 @@
 #include <cstring>
 #include <functional>
 #include <mutex>
+#include <new>
 #include <string>
 #include <thread>
 #include <vector>
@@ -279,16 +281,50 @@ class Pool {
     }
   }
 
+  /* fork safety (v3 ABI): a forked child inherits nworkers_ but NOT
+   * the detached worker threads — without a reset, run() in the
+   * child would never spawn replacements and every batch would
+   * decode on the caller thread alone (the multi-process data
+   * service forks exactly this way).  prepare locks mu_ so no
+   * worker is mid-claim at the fork instant; the child drops the
+   * phantom workers and any batches owned by threads that no longer
+   * exist, then re-arms lazily on its first run(). */
+ public:
+  void before_fork() { mu_.lock(); }
+  void after_fork_parent() { mu_.unlock(); }
+  void after_fork_child() {
+    /* the parent's detached workers were parked in cv_.wait at the
+     * fork instant, so the forked copies of mu_/cv_ carry waiter
+     * state for threads that do not exist here — unlocking is not
+     * enough (a child-side cv_.wait on that carcass hangs forever).
+     * Reinitialize both in place; the old state is garbage by
+     * definition and running a destructor on a condvar with waiters
+     * is itself undefined. */
+    new (&mu_) std::mutex();
+    new (&cv_) std::condition_variable();
+    nworkers_ = 0;
+    queue_.clear();
+  }
+
+ private:
   std::mutex mu_;
   std::condition_variable cv_;
   int nworkers_ = 0;
   std::vector<Batch *> queue_;
 };
 
+Pool *g_pool = nullptr;
+
 Pool &pool() {
   /* heap singleton, never destroyed: detached workers may still be
    * parked in cv_.wait at process exit */
-  static Pool *p = new Pool;
+  static Pool *p = [] {
+    g_pool = new Pool;
+    pthread_atfork([] { g_pool->before_fork(); },
+                   [] { g_pool->after_fork_parent(); },
+                   [] { g_pool->after_fork_child(); });
+    return g_pool;
+  }();
   return *p;
 }
 

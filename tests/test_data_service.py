@@ -1,0 +1,637 @@
+"""Sharded multi-process input data service (docs/data_service.md):
+shard-assignment exactly-once coverage, deterministic-mode bit-identity
+vs the single-process ImageRecordIter, exact mid-epoch resume across
+the process frontier, SIGKILL-worker supervision under the restart
+budget, globally-aggregated corrupt-record quarantine, the device
+prefetch depth knob, launch.py export, and the new lint rules."""
+import io as _pyio
+import os
+import pickle
+import signal
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import recordio as rio
+from incubator_mxnet_tpu import resilience as rz
+from incubator_mxnet_tpu import telemetry, tracing
+from incubator_mxnet_tpu.data_service import DataServiceIter
+from incubator_mxnet_tpu.io.sharding import (assigned_batches,
+                                             shard_keys, shard_range)
+from incubator_mxnet_tpu.resilience import DataPipelineError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SHAPE = (3, 48, 48)
+B = 8
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv("MXTPU_FAULT_SPEC", raising=False)
+    rz.reset_faults()
+    yield
+    rz.reset_faults()
+
+
+def _make_jpeg_rec(prefix, n, edge=64, bad=()):
+    """n labeled JPEG records (garbage payloads at ``bad`` indices)."""
+    from PIL import Image
+    rec = rio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    rs = np.random.RandomState(3)
+    for i in range(n):
+        if i in bad:
+            payload = b"\x00not-an-image" + bytes(rs.randint(
+                0, 256, 64, dtype=np.uint8))
+        else:
+            gx = np.linspace(0, 255, edge, dtype=np.float32)
+            img = (gx[None, :, None] * 0.4 + gx[:, None, None] * 0.4
+                   + rs.rand(edge, edge, 3) * 50).astype(np.uint8)
+            buf = _pyio.BytesIO()
+            Image.fromarray(img).save(buf, format="JPEG", quality=85)
+            payload = buf.getvalue()
+        rec.write_idx(i, rio.pack(
+            rio.IRHeader(0, float(i % 7), i, 0), payload))
+    rec.close()
+    return prefix
+
+
+@pytest.fixture(scope="module")
+def rec48(tmp_path_factory):
+    """48 records: divides evenly into 6 batches of 8."""
+    td = tmp_path_factory.mktemp("ds48")
+    return _make_jpeg_rec(str(td / "ds"), 48)
+
+
+@pytest.fixture(scope="module")
+def rec44(tmp_path_factory):
+    """44 records: partial tail batch (pad 4 under round_batch)."""
+    td = tmp_path_factory.mktemp("ds44")
+    return _make_jpeg_rec(str(td / "ds"), 44)
+
+
+def _single(prefix, **kw):
+    kw.setdefault("preprocess_threads", 2)
+    kw.setdefault("shuffle", False)
+    return mx.io.ImageRecordIter(
+        path_imgrec=prefix + ".rec", data_shape=SHAPE, batch_size=B,
+        round_batch=True, **kw)
+
+
+def _service(prefix, W, **kw):
+    kw.setdefault("preprocess_threads", 2)
+    return DataServiceIter(
+        path_imgrec=prefix + ".rec", data_shape=SHAPE, batch_size=B,
+        num_workers=W, round_batch=True, **kw)
+
+
+def _np_batches(it):
+    out = []
+    for b in it:
+        out.append((b.data[0].asnumpy().copy(),
+                    b.label[0].asnumpy().copy(), b.pad))
+    return out
+
+
+def _assert_same(got, ref, what=""):
+    assert len(got) == len(ref), (what, len(got), len(ref))
+    for i, ((d, l, p), (rd, rl, rp)) in enumerate(zip(got, ref)):
+        assert p == rp, (what, i, p, rp)
+        assert np.array_equal(d, rd), f"{what}: batch {i} data differs"
+        assert np.array_equal(l, rl), f"{what}: batch {i} label differs"
+
+
+def _shm_orphans():
+    return [f for f in os.listdir("/dev/shm")
+            if f.startswith("mxtpu_ds")]
+
+
+# ------------------------------------------------ sharding contracts
+@pytest.mark.parametrize("n,parts", [(48, 1), (48, 3), (44, 3),
+                                     (10, 3), (7, 8), (1, 4), (0, 2)])
+def test_shard_range_union_disjoint_exact(n, parts):
+    seen = []
+    prev_stop = 0
+    for k in range(parts):
+        start, stop = shard_range(n, parts, k)
+        assert start == prev_stop          # adjacent edges touch
+        assert start <= stop
+        seen.extend(range(start, stop))
+        prev_stop = stop
+    assert prev_stop == n
+    assert seen == list(range(n))          # union exact, no overlap
+
+
+def test_shard_range_balanced():
+    # floor arithmetic: part sizes differ by at most one (the naive
+    # n//P*k chunking loses up to P-1 tail records)
+    sizes = [shard_range(44, 3, k)[1] - shard_range(44, 3, k)[0]
+             for k in range(3)]
+    assert sum(sizes) == 44
+    assert max(sizes) - min(sizes) <= 1
+
+
+def test_shard_range_errors():
+    with pytest.raises(ValueError):
+        shard_range(10, 0, 0)
+    with pytest.raises(ValueError):
+        shard_range(10, 2, 2)
+    with pytest.raises(ValueError):
+        shard_range(10, 2, -1)
+
+
+@pytest.mark.parametrize("nb,W", [(6, 1), (6, 2), (7, 3), (2, 4)])
+def test_assigned_batches_union_disjoint(nb, W):
+    all_batches = []
+    for w in range(W):
+        mine = assigned_batches(nb, W, w)
+        assert mine == list(range(w, nb, W))
+        all_batches.extend(mine)
+    assert sorted(all_batches) == list(range(nb))
+
+
+def test_image_record_iter_parts_cover_exactly_once(rec44):
+    """num_parts/part_index cuts on record boundaries via the .idx
+    and covers every record exactly once (the off-by-one guard the
+    service's contiguous sharding reuses)."""
+    ids = []
+    for k in range(3):
+        it = _single(rec44, num_parts=3, part_index=k)
+        ids.append(list(it._keys))
+        # each part also delivers exactly its keys' worth of images
+        assert sum(B - b.pad for b in it) == len(ids[-1])
+    flat = [k for part in ids for k in part]
+    assert sorted(flat) == sorted(set(flat))          # disjoint
+    full = _single(rec44)
+    assert sorted(flat) == sorted(full._keys)         # union == all
+
+
+# -------------------------------------------------- bit-identity
+@pytest.mark.parametrize("W", [1, 2, 3])
+def test_service_bit_identical_to_single_process(rec48, W):
+    ref = _np_batches(_single(rec48))
+    with _service(rec48, W) as svc:
+        _assert_same(_np_batches(svc), ref, f"W={W}")
+    assert not _shm_orphans()
+
+
+def test_service_partial_tail_round_batch(rec44):
+    ref = _np_batches(_single(rec44))
+    assert ref[-1][2] == 4                      # pad under round_batch
+    with _service(rec44, 2) as svc:
+        _assert_same(_np_batches(svc), ref, "tail pad")
+
+
+def test_service_second_epoch_clean_turnover(rec48):
+    ref = _np_batches(_single(rec48))
+    with _service(rec48, 2) as svc:
+        _np_batches(svc)
+        procs = [p.pid for p in svc._procs]
+        svc.reset()                             # persistent workers:
+        _assert_same(_np_batches(svc), ref, "epoch 2")
+        assert [p.pid for p in svc._procs] == procs   # no respawn
+
+
+def test_service_midepoch_reset_restarts_epoch(rec48):
+    ref = _np_batches(_single(rec48))
+    with _service(rec48, 2) as svc:
+        for _ in range(2):
+            svc.next()
+        svc.reset()                             # mid-epoch abandon
+        _assert_same(_np_batches(svc), ref, "after abandon")
+
+
+def test_service_shuffled_epoch_matches_single_process(rec48):
+    np.random.seed(23)
+    ref = _np_batches(_single(rec48, shuffle=True))
+    np.random.seed(23)
+    with _service(rec48, 2, shuffle=True) as svc:
+        _assert_same(_np_batches(svc), ref, "shuffled")
+
+
+# ---------------------------------------------------------- resume
+@pytest.mark.parametrize("W", [1, 2])
+def test_service_state_roundtrip_resumes_exact_batch(rec48, W):
+    with _service(rec48, W) as svc:
+        for _ in range(3):
+            svc.next()
+        state = pickle.loads(pickle.dumps(svc.state_dict()))
+        want = _np_batches(svc)
+    with _service(rec48, W) as svc2:
+        svc2.load_state_dict(state)
+        svc2.reset()     # fit()'s epoch-start reset must not rewind
+        _assert_same(_np_batches(svc2), want, f"resume W={W}")
+
+
+def test_service_skip_matches_consumption(rec48):
+    with _service(rec48, 2) as svc:
+        for _ in range(2):
+            svc.next()
+        want = _np_batches(svc)
+    with _service(rec48, 2) as svc2:
+        svc2.skip(2)
+        _assert_same(_np_batches(svc2), want, "skip")
+
+
+def test_service_data_companion_roundtrip(rec48, tmp_path):
+    """The .data checkpoint companion path (model.save_data_state)
+    carries the multi-process position unchanged."""
+    from incubator_mxnet_tpu import model as M
+    prefix = str(tmp_path / "ckpt")
+    with _service(rec48, 2) as svc:
+        for _ in range(4):
+            svc.next()
+        M.save_data_state(prefix, 3, svc)
+        want = _np_batches(svc)
+    with _service(rec48, 2) as svc2:
+        assert M.load_data_state(prefix, 3, svc2)
+        svc2.reset()
+        _assert_same(_np_batches(svc2), want, "companion")
+
+
+def test_service_resume_worker_count_mismatch_raises(rec48):
+    with _service(rec48, 2) as svc:
+        svc.next()
+        state = svc.state_dict()
+    with _service(rec48, 3) as svc2:
+        with pytest.raises(ValueError, match="per-shard cursors"):
+            svc2.load_state_dict(state)
+
+
+def test_service_resume_wrong_dataset_raises(rec48, rec44):
+    with _service(rec48, 2) as svc:
+        state = svc.state_dict()
+    with _service(rec44, 2) as svc2:
+        with pytest.raises(ValueError, match="key set"):
+            svc2.load_state_dict(state)
+
+
+# ------------------------------------------------------ supervision
+def test_service_sigkill_worker_recovers_bit_identical(
+        rec48, monkeypatch):
+    monkeypatch.setenv("MXTPU_DATA_WORKER_RESTARTS", "2")
+    monkeypatch.setenv("MXTPU_DATA_TIMEOUT", "60")
+    ref = _np_batches(_single(rec48))
+    rec = tracing.get_recorder()
+    # depth-1 rings: a worker can stage at most one undelivered batch,
+    # so the SIGKILL below is guaranteed to interrupt the epoch
+    # mid-stream (a deep ring could hold the whole tiny epoch already)
+    with _service(rec48, 2, ring_depth=1) as svc:
+        got = [svc.next()]
+        os.kill(svc._procs[1].pid, signal.SIGKILL)
+        t0 = time.monotonic()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            try:
+                while True:
+                    got.append(svc.next())
+            except StopIteration:
+                pass
+        recovery_s = time.monotonic() - t0
+        assert svc._restarts == 1
+    got = [(b.data[0].asnumpy(), b.label[0].asnumpy(), b.pad)
+           for b in got]
+    _assert_same(got, ref, "after SIGKILL")
+    # bounded recovery: within the data timeout, not hanging forever
+    assert recovery_s < 60
+    assert rec.events("data_service_worker_dead")
+    assert rec.events("data_service_worker_restart")
+    assert not _shm_orphans()
+
+
+def test_service_restart_budget_exhausted_raises_typed(
+        rec48, monkeypatch):
+    monkeypatch.setenv("MXTPU_DATA_WORKER_RESTARTS", "0")
+    monkeypatch.setenv("MXTPU_DATA_TIMEOUT", "60")
+    with _service(rec48, 2, ring_depth=1) as svc:
+        svc.next()
+        os.kill(svc._procs[0].pid, signal.SIGKILL)
+        with pytest.raises(DataPipelineError, match="restart budget"):
+            while True:
+                svc.next()
+    assert not _shm_orphans()
+
+
+def test_service_worker_fault_injection_surfaces_typed(
+        rec48, monkeypatch):
+    monkeypatch.setenv("MXTPU_FAULT_SPEC", "data_service:worker:1:error")
+    with _service(rec48, 2) as svc:
+        with pytest.raises(DataPipelineError):
+            while True:
+                svc.next()
+
+
+def test_service_ring_fault_injection_surfaces(rec48, monkeypatch):
+    monkeypatch.setenv("MXTPU_FAULT_SPEC", "data_service:ring:1:error")
+    with _service(rec48, 2) as svc:
+        with pytest.raises(rz.TransientError):
+            svc.next()
+
+
+# ------------------------------------------------------- quarantine
+def test_service_quarantine_within_budget_tops_up(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("MXTPU_MAX_BAD_RECORDS", "4")
+    prefix = _make_jpeg_rec(str(tmp_path / "bad"), 48, bad={5, 19})
+    ref = _np_batches(_single(prefix))        # single-process path
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with _service(prefix, 2) as svc:
+            got = _np_batches(svc)
+    # same surviving images, full batches topped up from the stream
+    assert svc._bad_total == 2
+    total_ref = sum(B - p for _, _, p in ref)
+    total_got = sum(B - p for _, _, p in got)
+    assert total_got == total_ref == 46
+
+
+def test_service_quarantine_budget_zero_default_raises(
+        tmp_path, monkeypatch):
+    monkeypatch.delenv("MXTPU_MAX_BAD_RECORDS", raising=False)
+    prefix = _make_jpeg_rec(str(tmp_path / "bad0"), 24, bad={2})
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with _service(prefix, 2) as svc:
+            with pytest.raises(DataPipelineError,
+                               match="MXTPU_MAX_BAD_RECORDS"):
+                _np_batches(svc)
+
+
+def test_service_quarantine_aggregates_across_shards(
+        tmp_path, monkeypatch):
+    # one bad record per shard; budget 1 < 2 aggregate -> typed fail
+    monkeypatch.setenv("MXTPU_MAX_BAD_RECORDS", "1")
+    prefix = _make_jpeg_rec(str(tmp_path / "bad2"), 32, bad={1, 9})
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with _service(prefix, 2) as svc:
+            with pytest.raises(DataPipelineError, match="2 corrupt"):
+                _np_batches(svc)
+
+
+# -------------------------------------------- device prefetch depth
+def test_device_prefetch_depth_env_knob(monkeypatch):
+    from incubator_mxnet_tpu.io.io import (DevicePrefetchIter,
+                                           NDArrayIter)
+    monkeypatch.setenv("MXTPU_DEVICE_PREFETCH_DEPTH", "5")
+    inner = NDArrayIter(np.zeros((16, 2), np.float32), batch_size=4)
+    it = DevicePrefetchIter(inner, ctx=mx.cpu(0))
+    assert it._depth == 5
+    assert it._queue.maxsize == 5
+    it2 = DevicePrefetchIter(
+        NDArrayIter(np.zeros((16, 2), np.float32), batch_size=4),
+        ctx=mx.cpu(0), depth=3)
+    assert it2._depth == 3                     # explicit arg wins
+    assert sum(1 for _ in it) == 4
+
+
+def test_device_prefetch_depth_bounds_staging():
+    """A fast producer is staged at most depth+1 batches ahead
+    (depth queued + one in flight): bounded memory by construction."""
+    from incubator_mxnet_tpu.io.io import DevicePrefetchIter, NDArrayIter
+
+    class Counting(NDArrayIter):
+        pulled = 0
+
+        def next(self):
+            b = super().next()
+            type(self).pulled += 1
+            return b
+
+    inner = Counting(np.zeros((400, 2), np.float32), batch_size=4)
+    it = DevicePrefetchIter(inner, ctx=mx.cpu(0), depth=2)
+    deadline = time.monotonic() + 10
+    while Counting.pulled < 3 and time.monotonic() < deadline:
+        time.sleep(0.01)                 # let the stage fill
+    time.sleep(0.3)                      # would overrun if unbounded
+    assert Counting.pulled <= 2 + 1
+    n = sum(1 for _ in it)
+    assert n == 100
+
+
+def test_device_prefetch_over_service_end_to_end(rec48):
+    from incubator_mxnet_tpu.io.io import DevicePrefetchIter
+    ref = _np_batches(_single(rec48))
+    with _service(rec48, 2) as svc:
+        pre = DevicePrefetchIter(svc, ctx=mx.cpu(0), depth=3)
+        got = _np_batches(pre)
+    _assert_same(got, ref, "device prefetch over service")
+
+
+# ------------------------------------------------- telemetry & stats
+def test_service_stats_and_telemetry(rec48):
+    with _service(rec48, 2) as svc:
+        _np_batches(svc)
+        st = svc.stats()
+    assert st["img_per_sec"] > 0
+    assert st["restarts"] == 0 and st["bad_records"] == 0
+    assert set(st["shards"]) == {0, 1}
+    for shard in st["shards"].values():
+        assert shard["done"]
+        assert shard["delivered"] > 0
+    assert telemetry.counter("data_service_batches_total").value > 0
+
+
+# ------------------------------------------------------ launch flag
+def test_launch_data_workers_export():
+    import argparse
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "launch", os.path.join(REPO, "tools", "launch.py"))
+    launch = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(launch)
+    args = argparse.Namespace(num_workers=2, env=[],
+                              data_timeout=None, data_workers=4)
+    env = launch._worker_env(args, 0, "127.0.0.1:1", 0)
+    assert env["MXTPU_DATA_WORKERS"] == "4"
+    args.data_workers = None
+    env = launch._worker_env(args, 0, "127.0.0.1:1", 0)
+    assert "MXTPU_DATA_WORKERS" not in env
+
+
+# ------------------------------------------------------- lint rules
+def _load_lint():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "lint", os.path.join(REPO, "ci", "lint.py"))
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+    return lint
+
+
+def test_lint_flags_unbounded_semaphore_acquire(tmp_path):
+    lint = _load_lint()
+    d = tmp_path / "incubator_mxnet_tpu" / "data_service"
+    d.mkdir(parents=True)
+    f = d / "x.py"
+    f.write_text("def f(sem):\n    sem.acquire()\n")
+    assert any("unbounded .acquire()" in p for p in lint.check_file(f))
+    f.write_text("def f(sem):\n    sem.acquire(timeout=0.2)\n")
+    assert not any("unbounded" in p for p in lint.check_file(f))
+    f.write_text("def f(sem):\n"
+                 "    sem.acquire()  # deadline-ok: startup only\n")
+    assert not any("unbounded" in p for p in lint.check_file(f))
+
+
+def test_lint_dynamic_metric_prefix_rule(tmp_path):
+    lint = _load_lint()
+    d = tmp_path / "incubator_mxnet_tpu"
+    d.mkdir()
+    f = d / "m.py"
+    f.write_text("from incubator_mxnet_tpu.telemetry import gauge\n"
+                 "w = 1\n"
+                 "gauge('unheard_of_shard%d_rate' % w).set(1)\n")
+    probs = lint.check_metric_catalog([f])
+    assert any("no catalogued pattern" in p for p in probs)
+    f.write_text("from incubator_mxnet_tpu.telemetry import gauge\n"
+                 "w = 1\n"
+                 "gauge('data_service_shard%d_img_per_sec' % w)\n")
+    assert not lint.check_metric_catalog([f])
+
+
+def test_lint_flags_acquire_without_finite_timeout(tmp_path):
+    """acquire(True)/acquire(block=True)/wait(timeout=None) block
+    exactly like the zero-arg forms and must be flagged; the
+    non-blocking acquire(False) and any finite timeout are exempt."""
+    lint = _load_lint()
+    d = tmp_path / "incubator_mxnet_tpu" / "data_service"
+    d.mkdir(parents=True)
+    f = d / "x.py"
+    for bad in ("sem.acquire(True)", "sem.acquire(block=True)",
+                "sem.wait(timeout=None)"):
+        f.write_text(f"def f(sem):\n    {bad}\n")
+        assert any("unbounded" in p for p in lint.check_file(f)), bad
+    for ok in ("sem.acquire(False)", "sem.acquire(True, 0.2)",
+               "sem.acquire(timeout=0.2)", "sem.wait(0.5)",
+               "sem.wait(timeout=1.0)"):
+        f.write_text(f"def f(sem):\n    {ok}\n")
+        assert not any("unbounded" in p
+                       for p in lint.check_file(f)), ok
+
+
+def test_service_rand_mirror_resume_and_restart_exact(
+        rec48, monkeypatch):
+    """Mirror draws are keyed to the GLOBAL batch index and the seed
+    base rides the state_dict: mid-epoch resume and dead-worker
+    respawn reproduce the exact per-batch mirror pattern the
+    uninterrupted epoch used (native path)."""
+    monkeypatch.setenv("MXTPU_DATA_WORKER_RESTARTS", "2")
+    np.random.seed(11)
+    with _service(rec48, 2, rand_mirror=True) as svc:
+        ref = _np_batches(svc)
+    np.random.seed(11)
+    with _service(rec48, 2, rand_mirror=True) as svc:
+        _assert_same(_np_batches(svc), ref, "mirror determinism")
+    # mid-epoch checkpoint + resume in a FRESH service (whose own
+    # seed base differs) lands on the same remaining batches
+    np.random.seed(11)
+    head = []
+    with _service(rec48, 2, rand_mirror=True) as svc:
+        for _ in range(3):
+            b = svc.next()
+            head.append((b.data[0].asnumpy().copy(),
+                         b.label[0].asnumpy().copy(), b.pad))
+        state = pickle.loads(pickle.dumps(svc.state_dict()))
+    with _service(rec48, 2, rand_mirror=True) as svc2:
+        svc2.load_state_dict(state)
+        svc2.reset()
+        _assert_same(head + _np_batches(svc2), ref,
+                     "rand_mirror resume")
+    # a SIGKILLed worker respawns mid-epoch with the same draws
+    np.random.seed(11)
+    with _service(rec48, 2, rand_mirror=True, ring_depth=1) as svc:
+        got = [svc.next()]
+        os.kill(svc._procs[1].pid, signal.SIGKILL)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            try:
+                while True:
+                    got.append(svc.next())
+            except StopIteration:
+                pass
+        assert svc._restarts == 1
+    got = [(b.data[0].asnumpy(), b.label[0].asnumpy(), b.pad)
+           for b in got]
+    _assert_same(got, ref, "rand_mirror after SIGKILL")
+
+
+def test_ring_put_error_oversized_payload_stays_typed():
+    """A worker exception whose pickle exceeds the slot's data area
+    must arrive as a typed, truncated summary — not a slot-cut
+    pickle that unpickles to a bare UnpicklingError."""
+    import multiprocessing as _mp
+    from incubator_mxnet_tpu.data_service import ring as _ring
+    r = _ring.ShmBatchRing(1, (1, 8, 8), 1, 1,
+                           _mp.get_context("fork"))
+    try:
+        assert r.put_error(ValueError("boom " * 50000))
+        kind, _, _, _, _, _, payload = r.get("t", lambda: True, 5)
+        assert kind == _ring.KIND_ERROR
+        assert isinstance(payload, DataPipelineError)
+        assert "ValueError" in str(payload)
+    finally:
+        r.close()
+
+
+@pytest.fixture(scope="module")
+def rec_png24(tmp_path_factory):
+    """24 PNG records: every batch fails the native JPEG gate, so
+    the whole epoch decodes via the PIL-fallback path."""
+    from PIL import Image
+    td = tmp_path_factory.mktemp("dspng")
+    prefix = str(td / "ds")
+    rec = rio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    rs = np.random.RandomState(5)
+    for i in range(24):
+        img = rs.randint(0, 256, (64, 64, 3), dtype=np.uint8)
+        buf = _pyio.BytesIO()
+        Image.fromarray(img).save(buf, format="PNG")
+        rec.write_idx(i, rio.pack(
+            rio.IRHeader(0, float(i % 7), i, 0), buf.getvalue()))
+    rec.close()
+    return prefix
+
+
+def test_service_rand_mirror_pil_path_resume_and_restart_exact(
+        rec_png24, monkeypatch):
+    """The PIL-fallback augmenters draw from the stdlib `random`
+    module; the per-batch reseed must make THAT path bit-exact
+    across respawn/resume too, not just the native mirror vector."""
+    monkeypatch.setenv("MXTPU_DATA_WORKER_RESTARTS", "2")
+    np.random.seed(13)
+    with _service(rec_png24, 2, rand_mirror=True) as svc:
+        ref = _np_batches(svc)
+    np.random.seed(13)
+    with _service(rec_png24, 2, rand_mirror=True) as svc:
+        _assert_same(_np_batches(svc), ref, "pil mirror determinism")
+    np.random.seed(13)
+    head = []
+    with _service(rec_png24, 2, rand_mirror=True) as svc:
+        b = svc.next()
+        head.append((b.data[0].asnumpy().copy(),
+                     b.label[0].asnumpy().copy(), b.pad))
+        state = pickle.loads(pickle.dumps(svc.state_dict()))
+    with _service(rec_png24, 2, rand_mirror=True) as svc2:
+        svc2.load_state_dict(state)
+        svc2.reset()
+        _assert_same(head + _np_batches(svc2), ref,
+                     "pil rand_mirror resume")
+    np.random.seed(13)
+    with _service(rec_png24, 2, rand_mirror=True,
+                  ring_depth=1) as svc:
+        got = [svc.next()]
+        os.kill(svc._procs[1].pid, signal.SIGKILL)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            try:
+                while True:
+                    got.append(svc.next())
+            except StopIteration:
+                pass
+        assert svc._restarts == 1
+    got = [(b.data[0].asnumpy(), b.label[0].asnumpy(), b.pad)
+           for b in got]
+    _assert_same(got, ref, "pil rand_mirror after SIGKILL")

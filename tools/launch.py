@@ -99,6 +99,11 @@ def _worker_env(args, rank, coord, attempt):
         # clean, restartable exit) while its heartbeat is still
         # beating — heartbeats only catch wedged *processes*
         env["MXTPU_DATA_TIMEOUT"] = str(args.data_timeout)
+    if getattr(args, "data_workers", None) is not None:
+        # every rank runs its own data service with this many decode
+        # worker processes (DataServiceIter reads the flag when
+        # num_workers is not passed; docs/data_service.md)
+        env["MXTPU_DATA_WORKERS"] = str(args.data_workers)
     if getattr(args, "nonfinite_policy", None):
         env["MXTPU_NONFINITE_POLICY"] = args.nonfinite_policy
     if getattr(args, "max_bad_steps", None) is not None:
@@ -172,6 +177,7 @@ def _hb_path(hb_dir, attempt, rank):
 _ERROR_COUNTERS = ("retry_attempts_total", "collective_aborts_total",
                    "data_quarantined_records_total",
                    "dataloader_worker_restarts_total",
+                   "data_service_worker_restarts_total",
                    "sentinel_bad_steps_total",
                    "sentinel_skipped_steps_total",
                    "sentinel_divergences_total", "rollbacks_total",
@@ -243,13 +249,18 @@ def _aggregate_telemetry(snaps):
     max-memory rank — the one that OOMs first."""
     agg = {"ranks": sorted(snaps), "counters": {}, "throughput": 0.0,
            "steps": {}, "straggler": None, "memory": {},
-           "compiles": {}, "max_memory": None}
+           "compiles": {}, "max_memory": None, "data_img_s": 0.0,
+           "data_img_s_by_rank": {}}
     for rank, snap in snaps.items():
         for name, v in (snap.get("counters") or {}).items():
             agg["counters"][name] = agg["counters"].get(name, 0) + v
         gauges = snap.get("gauges") or {}
         agg["throughput"] += gauges.get("throughput_samples_per_sec",
                                         0.0)
+        ds = gauges.get("data_service_img_per_sec", 0.0) or 0.0
+        if ds > 0:
+            agg["data_img_s"] += ds
+            agg["data_img_s_by_rank"][rank] = ds
         agg["steps"][rank] = (snap.get("counters") or {}).get(
             "train_steps_total", 0)
         mem = _rank_memory(snap)
@@ -276,6 +287,8 @@ def _format_status(agg):
     parts = [f"{len(agg['ranks'])} rank(s)", f"steps={steps}"]
     if agg["throughput"] > 0:
         parts.append(f"{agg['throughput']:.1f} samples/s")
+    if agg.get("data_img_s", 0) > 0:
+        parts.append(f"data: {agg['data_img_s']:.0f} img/s")
     errs = [f"{n}={agg['counters'][n]}" for n in _ERROR_COUNTERS
             if agg["counters"].get(n)]
     if errs:
@@ -305,10 +318,12 @@ def _format_report(snaps):
         tp = gauges.get("throughput_samples_per_sec")
         mem = agg["memory"].get(rank)
         compiles = agg["compiles"].get(rank)
+        data_tp = agg["data_img_s_by_rank"].get(rank)
         lines.append(
             f"launch.py:   rank {rank}: steps="
             f"{agg['steps'].get(rank, 0)}"
             + (f" {tp:.1f} samples/s" if tp else "")
+            + (f" data={data_tp:.0f} img/s" if data_tp else "")
             + (f" mem={_fmt_bytes(mem)}" if mem else "")
             + (f" compiles={compiles}" if compiles else ""))
     nonzero = {n: v for n, v in sorted(agg["counters"].items()) if v}
@@ -448,6 +463,13 @@ def main():
                     "input-pipeline queue waits past this many "
                     "seconds raise DataPipelineError (a restartable "
                     "failure) instead of hanging; unset leaves the "
+                    "workers' own env/default")
+    ap.add_argument("--data-workers", type=int, default=None,
+                    help="export MXTPU_DATA_WORKERS to every worker: "
+                    "decode worker processes each rank's "
+                    "DataServiceIter spawns (the sharded "
+                    "multi-process input service, "
+                    "docs/data_service.md); unset leaves the "
                     "workers' own env/default")
     ap.add_argument("--nonfinite-policy", default=None,
                     choices=["off", "warn", "skip", "raise"],
