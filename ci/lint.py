@@ -85,6 +85,13 @@ HOT_SYNC_FUNCS = {"step", "update", "__call__", "begin_step",
                   # serving scheduler loop + decode step
                   "_admit", "_grow", "_decode_once", "_append_token",
                   "_retire", "_preempt", "_fail", "stream", "run",
+                  # serving survival layer: the reap sweep and every
+                  # terminal path run inside the engine iteration,
+                  # and drain/snapshot/cancel may run under SIGTERM —
+                  # none may add a device->host sync
+                  "_reap", "_release", "_expire", "_cancel_now",
+                  "_finalize", "drain", "_latch_drain", "cancel",
+                  "snapshot", "stream_request", "_stream_gen",
                   # tracing producers + memory sampling
                   "trace_event", "record", "device_memory_stats",
                   "update_memory_gauges", "_rss_bytes"}
@@ -92,6 +99,20 @@ HOT_SYNC_FUNCS = {"step", "update", "__call__", "begin_step",
 SYNC_ATTRS = {"item", "asscalar", "asnumpy"}
 SYNC_ROOT_ATTRS = {("np", "asarray"), ("numpy", "asarray"),
                    ("jax", "device_get")}
+
+# Deadline/timeout modules (serving SLOs + the resilience layer's
+# deadline machinery; docs/serving.md "SLOs, shedding, and drain").
+# In these, bare ``time.time()`` is forbidden: the wall clock jumps
+# under NTP slew/step and host suspend, so deadline or timeout
+# arithmetic built on it can expire live requests en masse (or never
+# expire anything).  All deadline math must use time.monotonic();
+# a deliberate wall-clock STAMP (an absolute timestamp written for
+# humans or cross-host readers, never subtracted against a deadline)
+# carries `# wallclock-ok: <why>` on the line.
+MONO_CLOCK_PATHS = (
+    "incubator_mxnet_tpu/serving/",
+    "incubator_mxnet_tpu/resilience.py",
+)
 
 # MXTPU_-prefixed tokens that are NOT environment variables (log
 # markers etc.) — exempt from the env-var documentation check.
@@ -318,6 +339,24 @@ def check_file(path):
             not any(d in posix for d in GRAPH_MUTATION_DIRS):
         problems.extend(
             _graph_mutation_problems(path, tree, src.splitlines()))
+    if any(m in posix if m.endswith("/") else posix.endswith(m)
+           for m in MONO_CLOCK_PATHS):
+        lines = src.splitlines()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "time" \
+                    and _attr_root(node.func.value) == "time":
+                line = lines[node.lineno - 1] \
+                    if node.lineno - 1 < len(lines) else ""
+                if "wallclock-ok" in line:
+                    continue
+                problems.append(
+                    f"{path}:{node.lineno}: time.time() in a "
+                    "deadline/timeout module — the wall clock jumps "
+                    "(NTP, suspend), so deadline arithmetic must use "
+                    "time.monotonic(); a deliberate wall-clock stamp "
+                    "needs '# wallclock-ok: <why>' on the line")
     if any(posix.endswith(m) for m in SPAN_TIMING_MODULES):
         lines = src.splitlines()
         for node in ast.walk(tree):

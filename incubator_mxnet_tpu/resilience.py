@@ -25,8 +25,10 @@ is the one place those defenses live:
   path above is testable on CPU: ``scope:op:nth:kind`` (e.g.
   ``collective:allreduce:2:hang``, ``checkpoint:save:1:truncate``;
   the data service's decode workers and rings inject under
-  ``data_service:worker`` / ``data_service:ring``); see
-  docs/resilience.md for the grammar.
+  ``data_service:worker`` / ``data_service:ring``, the serving
+  tier under ``serve:request`` / ``serve:step`` /
+  ``serve:deadline`` / ``serve:queue``); see docs/resilience.md
+  for the grammar.
 
 Everything here is stdlib-only and import-light so dist workers can
 use it before jax is up.
@@ -927,7 +929,9 @@ def _beat(path):
     cluster status line and final run report; mtime-based monitors
     and first-line parsers are unaffected.  A telemetry failure must
     never silence the liveness signal."""
-    payload = f"{time.time():.3f}\n"
+    # an absolute stamp the launcher monitor reads across processes —
+    # never subtracted against a deadline
+    payload = f"{time.time():.3f}\n"  # wallclock-ok: monitor stamp
     try:
         from . import telemetry
         extra = telemetry.heartbeat_payload()

@@ -17,11 +17,16 @@ from .cache_manager import PrefixCache
 from .engine import ServingEngine
 from .quantize import (quantization_error, quantize_weights,
                        weights_nbytes)
-from .scheduler import (FAILED, FINISHED, QUEUED, RUNNING, Request,
-                        Scheduler, SchedulingError)
+from .scheduler import (CANCELLED, EXPIRED, FAILED, FINISHED, QUEUED,
+                        RUNNING, TERMINAL_STATES, Request,
+                        RequestTooLargeError, Scheduler,
+                        SchedulingError, ServeRejectedError,
+                        ServingError)
 
 __all__ = ["ServingEngine", "BlockPool", "BlockPoolExhausted",
-           "PrefixCache", "Request", "Scheduler", "SchedulingError",
-           "quantize_weights", "quantization_error",
-           "weights_nbytes", "QUEUED", "RUNNING", "FINISHED",
-           "FAILED"]
+           "PrefixCache", "Request", "Scheduler", "ServingError",
+           "SchedulingError", "ServeRejectedError",
+           "RequestTooLargeError", "quantize_weights",
+           "quantization_error", "weights_nbytes", "QUEUED",
+           "RUNNING", "FINISHED", "FAILED", "EXPIRED", "CANCELLED",
+           "TERMINAL_STATES"]

@@ -80,6 +80,16 @@ class BlockPool:
         """Current holders of ``block_id`` (0 when free)."""
         return self._ref.get(block_id, 0)
 
+    def live(self):
+        """Snapshot of live block refcounts ``{block_id: holders}``.
+
+        The leak-audit view: after an engine drains, every live
+        block must be accounted for by the prefix cache alone — the
+        chaos/regression tests assert exactly that, so a terminal
+        path (retire/evict/expire/cancel) that forgets to free shows
+        up as a named block with a holder nobody owns."""
+        return dict(self._ref)
+
     # ----------------------------------------------------- lifecycle
     def alloc(self, n=1):
         """Allocate ``n`` blocks at refcount 1; returns their ids.

@@ -99,6 +99,18 @@ class PrefixCache:
             added += 1
         return added
 
+    def block_refs(self):
+        """``{block_id: refs held by the cache}`` — the cache's side
+        of the pool leak audit: after an engine drains, every live
+        pool block's refcount must be exactly what this returns (the
+        chaos and leak-audit tests assert the equality against
+        ``BlockPool.live()``, so a terminal path that leaks a
+        request's hold on a shared block is caught by id)."""
+        refs = {}
+        for bid in self._entries.values():
+            refs[bid] = refs.get(bid, 0) + 1
+        return refs
+
     def evict(self, n):
         """Free up to ``n`` cache-held blocks in LRU order, skipping
         any still shared with a live request.  Returns blocks

@@ -20,6 +20,19 @@ sequence.  :class:`ServingEngine` replaces that with:
 - **int8 weight quantization** (``quantize.quantize_weights``) for
   weight-stream density, dequantized inside the jit.
 
+The SLO/survival layer (docs/serving.md "SLOs, shedding, and
+drain") rides the same loop: per-request TTFT/total **deadlines**
+enforced on monotonic clocks (terminal ``expired``), client
+**cancellation** (``cancel()`` / abandoned ``stream_request()``,
+terminal ``cancelled``), **admission control** (bounded queue +
+queued-token budget -> typed ``ServeRejectedError`` at ``submit()``),
+graceful **drain** + atomic **snapshot/restore** of all in-flight
+requests (greedy recompute makes the continuation token-identical,
+SIGTERM wired to snapshot-then-drain), and a **decode-step
+watchdog** dumping the flight recorder on budget overruns.  Every
+new path is injectable: ``MXTPU_FAULT_SPEC`` scopes ``serve:step`` /
+``serve:deadline`` / ``serve:queue`` next to ``serve:request``.
+
 The decode loop's only device->host sync is the per-iteration token
 read (enforced by ci/lint.py's host-sync rule over this module).
 Telemetry rides the process registry: request/ token counters,
@@ -29,6 +42,7 @@ pool-utilization gauges.  ``MXTPU_FAULT_SPEC`` scope
 (state ``failed``) without touching its batchmates.
 """
 import itertools
+import os
 import threading
 import time
 import weakref
@@ -42,10 +56,13 @@ from ..utils.log import get_logger
 from .block_table import BlockPool, BlockPoolExhausted
 from .cache_manager import PrefixCache
 from .quantize import quantize_weights
-from .scheduler import (FAILED, FINISHED, QUEUED, Request, Scheduler,
-                        SchedulingError)
+from .scheduler import (CANCELLED, EXPIRED, FAILED, FINISHED, QUEUED,
+                        Request, RequestTooLargeError, Scheduler,
+                        SchedulingError, ServeRejectedError)
 
 __all__ = ["ServingEngine"]
+
+SNAPSHOT_VERSION = 1
 
 # process-unique engine ids: request ids restart at 0 per engine, so
 # trace events carry (engine, rid) — a post-mortem dump spanning two
@@ -73,6 +90,16 @@ class ServingEngine:
     keep_logits : retain each slot's last-step logits on the request
         (device array; for validation/debugging — never host-read by
         the engine)
+    ttft_deadline / deadline : default per-request SLOs in seconds
+        (``MXTPU_SERVE_TTFT_DEADLINE`` / ``MXTPU_SERVE_DEADLINE``;
+        0 disables) — ``submit(..., ttft_deadline=, deadline=)``
+        overrides per request
+    queue_limit / queue_tokens : admission control
+        (``MXTPU_SERVE_QUEUE_LIMIT`` / ``MXTPU_SERVE_QUEUE_TOKENS``;
+        0 = unbounded): past either bound ``submit()`` sheds with a
+        typed :class:`ServeRejectedError`
+    step_timeout : decode-step watchdog budget in seconds
+        (``MXTPU_SERVE_STEP_TIMEOUT``; 0 disables)
 
     Decoding is greedy (temperature-0) — the batch-invariant mode
     whose outputs are provably identical to sequential
@@ -86,7 +113,9 @@ class ServingEngine:
 
     def __init__(self, model, max_batch=None, block_size=None,
                  num_blocks=None, quantize=None, prefix_cache=None,
-                 keep_logits=False):
+                 keep_logits=False, ttft_deadline=None,
+                 deadline=None, queue_limit=None, queue_tokens=None,
+                 step_timeout=None):
         from ..gluon.model_zoo.transformer import TransformerLM
         if not isinstance(model, TransformerLM):
             raise TypeError(
@@ -107,6 +136,24 @@ class ServingEngine:
                     if quantize is None else quantize)
         if prefix_cache is None:
             prefix_cache = get_env("MXTPU_SERVE_PREFIX_CACHE")
+        # SLO/survival knobs (docs/serving.md "SLOs, shedding, and
+        # drain"); every deadline comparison is monotonic-clock
+        # (lint-enforced — wall clock jumps must never expire work)
+        self.ttft_deadline = float(
+            ttft_deadline if ttft_deadline is not None
+            else get_env("MXTPU_SERVE_TTFT_DEADLINE"))
+        self.deadline = float(
+            deadline if deadline is not None
+            else get_env("MXTPU_SERVE_DEADLINE"))
+        self.queue_limit = int(
+            queue_limit if queue_limit is not None
+            else get_env("MXTPU_SERVE_QUEUE_LIMIT"))
+        self.queue_tokens = int(
+            queue_tokens if queue_tokens is not None
+            else get_env("MXTPU_SERVE_QUEUE_TOKENS"))
+        self.step_timeout = float(
+            step_timeout if step_timeout is not None
+            else get_env("MXTPU_SERVE_STEP_TIMEOUT"))
 
         self.model = model
         # one table row spans the model's full context budget
@@ -141,7 +188,30 @@ class ServingEngine:
         self.trace_counts = {}
         self._next_id = 0
         self._submit_lock = threading.Lock()
-        self._completed = []        # retired/failed since last run()
+        self._completed = []        # terminal since last run()/drain()
+        # SLO/survival state: live requests by id (cancel() target),
+        # terminal-state counts for stats(), the drain latch, and
+        # two cheap arm counters that keep the reap sweep off the
+        # decode hot path when no deadline/cancel is pending
+        self._live = {}
+        self._terminal_counts = {}
+        self._draining = False
+        self._deadlines_armed = 0
+        self._cancels_pending = 0
+        # earliest armed deadline stamp: the reap sweep skips the
+        # queue walk entirely until the clock reaches it (or a
+        # cancel is pending); recomputed by every sweep
+        self._deadline_next = float("inf")
+        # the one request mid-transit between queue and slot
+        # (_admit pop->place, _preempt clear->requeue): a SIGTERM
+        # snapshot() interrupting that window must still see it —
+        # it is in neither sched.waiting nor sched.slots
+        self._in_transit = None
+        # lock-free dirty bit for stream_request abandons: set with
+        # a plain store from whatever thread GC runs the finalizer
+        # on (cancel()'s lock would deadlock there); tells the reap
+        # sweep to run even though _cancels_pending was not bumped
+        self._abandon_flagged = False
         # flight recorder: compile attribution for the traced
         # builders, terminal per-request summaries for stats(), and
         # KV-pool bytes attributed in the device-memory gauges (via
@@ -195,6 +265,15 @@ class ServingEngine:
         self._h_ttft = telemetry.histogram("serving_ttft_seconds")
         self._h_tok = telemetry.histogram(
             "serving_token_latency_seconds")
+        self._m_rejected = telemetry.counter(
+            "serving_rejected_total")
+        self._m_expired = telemetry.counter("serving_expired_total")
+        self._m_cancelled = telemetry.counter(
+            "serving_cancelled_total")
+        self._m_drains = telemetry.counter("serving_drains_total")
+        self._m_qdepth = telemetry.gauge("serving_queue_depth")
+        self._m_qtokens = telemetry.gauge(
+            "serving_queued_prompt_tokens")
 
     # ---------------------------------------------------------- setup
     @staticmethod
@@ -273,12 +352,60 @@ class ServingEngine:
         return bucket, fn
 
     # ------------------------------------------------------------- API
-    def submit(self, tokens, max_new_tokens, eos_id=None):
+    def _check_servable(self, n_tokens, max_new):
+        """Raise :class:`RequestTooLargeError` when a request of
+        ``n_tokens`` prompt + ``max_new`` generated tokens can NEVER
+        be served by this engine — queueing it would hang the
+        schedule forever (docs/serving.md)."""
+        total = n_tokens + max_new
+        if total > self.model._max_len:
+            raise RequestTooLargeError(
+                f"prompt+new = {total} exceeds max_len "
+                f"{self.model._max_len}")
+        need = -(-total // self.block_size)
+        if need > min(self.max_blocks, self.pool.capacity):
+            raise RequestTooLargeError(
+                f"request needs {need} blocks but the pool serves "
+                f"at most {min(self.max_blocks, self.pool.capacity)}"
+                " per sequence — raise MXTPU_SERVE_NUM_BLOCKS or "
+                "shrink the request")
+
+    def _reject(self, n_tokens, reason):
+        """Shed one submission: exactly one terminal trace event
+        (queue context attached — a rejected request never waited,
+        the event says what it would have waited behind), counters,
+        then the typed raise."""
+        depth = len(self._sched.waiting)
+        qtok = self._sched.queued_tokens
+        self._m_rejected.inc()
+        self._terminal_counts["rejected"] = \
+            self._terminal_counts.get("rejected", 0) + 1
+        tracing.trace_event(
+            "serve_reject", engine=self.engine_id,
+            prompt_tokens=n_tokens, reason=reason,
+            queue_depth=depth, queued_tokens=qtok)
+        raise ServeRejectedError(
+            f"request rejected ({reason}): queue depth {depth}"
+            f"/{self.queue_limit or 'inf'}, queued tokens {qtok}"
+            f"/{self.queue_tokens or 'inf'} — shedding keeps "
+            "admitted requests' latency bounded (docs/serving.md)")
+
+    def submit(self, tokens, max_new_tokens, eos_id=None,
+               ttft_deadline=None, deadline=None):
         """Enqueue a prompt; returns its :class:`Request` handle.
 
         ``tokens`` is a 1D int sequence (list / numpy / NDArray).
         The handle's ``generated`` list fills as the engine runs
-        (drive it via :meth:`step`, :meth:`stream` or :meth:`run`)."""
+        (drive it via :meth:`step`, :meth:`stream` or :meth:`run`).
+
+        ``ttft_deadline`` / ``deadline`` (seconds; default the
+        engine's env-configured SLOs, 0/None = none) bound first
+        token and total completion — a request past either expires
+        (state ``expired``, blocks freed) instead of occupying the
+        engine.  Raises :class:`RequestTooLargeError` when the
+        request can never fit the pool/context, and
+        :class:`ServeRejectedError` when admission control sheds it
+        (bounded queue, token budget, or draining engine)."""
         if hasattr(tokens, "asnumpy"):
             tokens = tokens.asnumpy()
         toks = [int(t) for t in np.asarray(tokens).ravel()]
@@ -288,22 +415,52 @@ class ServingEngine:
         if max_new < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1 (got {max_new})")
-        total = len(toks) + max_new
-        if total > self.model._max_len:
-            raise ValueError(
-                f"prompt+new = {total} exceeds max_len "
-                f"{self.model._max_len}")
-        need = -(-total // self.block_size)
-        if need > min(self.max_blocks, self.pool.capacity):
-            raise ValueError(
-                f"request needs {need} blocks but the pool serves "
-                f"at most {min(self.max_blocks, self.pool.capacity)}"
-                " per sequence — raise MXTPU_SERVE_NUM_BLOCKS or "
-                "shrink the request")
+        self._check_servable(len(toks), max_new)
+        if ttft_deadline is None:
+            ttft_deadline = self.ttft_deadline
+        if deadline is None:
+            deadline = self.deadline
         with self._submit_lock:     # submit() may race across threads
+            # admission control: shed at the door — a bounded queue
+            # turns overload into fast typed failures instead of
+            # unbounded TTFT collapse.  Preemption requeues bypass
+            # this (push_front): they were already admitted.
+            if self._draining:
+                self._reject(len(toks), "draining")
+            if self.queue_limit > 0 and \
+                    len(self._sched.waiting) >= self.queue_limit:
+                self._reject(len(toks), "queue_limit")
+            if self.queue_tokens > 0 and \
+                    self._sched.queued_tokens + len(toks) \
+                    > self.queue_tokens:
+                self._reject(len(toks), "queue_tokens")
+            try:
+                # injectable shedding: MXTPU_FAULT_SPEC
+                # serve:queue:N:error rejects the Nth submission
+                resilience.inject("serve", "queue")
+            except resilience.TransientError:
+                self._reject(len(toks), "injected")
             req = Request(self._next_id, toks, max_new,
                           eos_id=eos_id)
             self._next_id += 1
+            now = time.monotonic()
+            try:
+                # injectable SLO breach: serve:deadline:N:error
+                # forces the Nth submission to expire at the next
+                # engine iteration, whatever its configured deadline
+                resilience.inject("serve", "deadline")
+            except resilience.TransientError:
+                req.deadline_ts = now - 1.0
+            else:
+                if ttft_deadline and ttft_deadline > 0:
+                    req.ttft_deadline_ts = now + float(ttft_deadline)
+                if deadline and deadline > 0:
+                    req.deadline_ts = now + float(deadline)
+            if req.ttft_deadline_ts is not None \
+                    or req.deadline_ts is not None:
+                self._deadlines_armed += 1
+                self._deadline_next = min(self._deadline_next,
+                                          self._next_deadline(req))
             # lifecycle + async events fire BEFORE the scheduler can
             # see the request: once added, a concurrent engine
             # thread may admit it immediately, and serve_admit must
@@ -314,19 +471,55 @@ class ServingEngine:
                                 max_new_tokens=max_new)
             self._prof_async("b", "request", req)
             self._prof_async("b", "queue_wait", req)
+            self._live[req.id] = req
             self._sched.add(req)
+            self._m_qdepth.set(len(self._sched.waiting))
+            self._m_qtokens.set(self._sched.queued_tokens)
         self._m_requests.inc()
         return req
 
+    def cancel(self, rid):
+        """Request cancellation of a live request by id (thread-safe;
+        clients may call it from any thread, including a stream
+        consumer that lost interest).  Honored at the next engine
+        iteration: the request reaches terminal state ``cancelled``
+        with its partial output retained and every pool block freed
+        — cancellation can never leak blocks.  Returns True when the
+        request was live and is now marked; False when unknown or
+        already terminal."""
+        with self._submit_lock:
+            req = self._live.get(rid)
+            if req is None or req.done or req.cancel_requested:
+                return False
+            req.cancel_requested = True
+            req.cancel_counted = True
+            self._cancels_pending += 1
+            return True
+
     def has_work(self):
-        """Whether any submitted request is still queued/running."""
+        """Whether driving the engine can still make progress: any
+        request queued/running — or, while draining, only the
+        RUNNING batch.  Queued requests are frozen for
+        :meth:`snapshot` once drain latches; reporting them here
+        would spin a ``while engine.has_work(): engine.step()``
+        driver forever on work admission will never start."""
+        return self._has_loop_work()
+
+    def _has_loop_work(self):
+        """What drives stream()/run(): everything, or — while
+        draining — only the running batch (queued requests are
+        deliberately left for snapshot(), never admitted)."""
+        if self._draining:
+            return self._sched.any_running()
         return self._sched.has_work()
 
     def step(self):
-        """One continuous-batching iteration: admit -> grow ->
-        decode -> retire.  Returns the ``(request, token_id)``
-        events emitted this iteration."""
+        """One continuous-batching iteration: reap (cancellations +
+        expired deadlines, blocks freed same-iteration) -> admit ->
+        grow -> decode -> retire.  Returns the ``(request,
+        token_id)`` events emitted this iteration."""
         events = []
+        self._reap()
         self._admit(events)
         if self._sched.any_running():
             self._grow()
@@ -334,24 +527,384 @@ class ServingEngine:
             self._decode_once(events)
         self._m_occ.set(self._sched.n_running() / self.max_batch)
         self._m_util.set(self.pool.utilization())
+        self._m_qdepth.set(len(self._sched.waiting))
+        self._m_qtokens.set(self._sched.queued_tokens)
         return events
 
     def stream(self):
         """Drive the engine, yielding ``(request, token_id)`` events
-        as they are produced, until all submitted work drains."""
-        while self._sched.has_work():
+        as they are produced, until all submitted work drains (or,
+        while draining, until the running batch finishes)."""
+        while self._has_loop_work():
             for ev in self.step():
                 yield ev
 
+    def stream_request(self, req):
+        """Drive the engine yielding ``req``'s tokens only — the
+        per-client streaming view.  ABANDONING the generator (break
+        / ``close()`` / GC — started or not) cancels the request: a
+        client that hung up must not keep burning decode slots and
+        KV blocks.  The abandon path only FLAGS the cancellation,
+        with plain attribute stores — a GC finalizer may run it on
+        any thread, even reentrantly inside ``step()`` or under the
+        submit lock, where taking a lock or mutating scheduler/pool
+        state would deadlock or corrupt the iteration — and the
+        next engine iteration finalizes it as CANCELLED, freeing
+        its blocks.  A NORMAL exit (the request finished, or drain
+        latched and the loop ran out of work) cancels nothing: a
+        drained-but-queued request belongs to :meth:`snapshot`."""
+        # shared cell, not a local: a generator abandoned before its
+        # first next() never enters the body (GEN_CREATED close/GC
+        # runs no code), so the body's finally cannot cover that
+        # case — the weakref.finalize on the generator object does,
+        # and the cell tells it a normal exhaustion already happened
+        state = {"exhausted": False}
+
+        def _flag():
+            if not state["exhausted"] and not req.done:
+                req.cancel_requested = True
+                self._abandon_flagged = True
+
+        gen = self._stream_gen(req, state, _flag)
+        weakref.finalize(gen, _flag)
+        return gen
+
+    def _stream_gen(self, req, state, flag):
+        # yield from a CURSOR over req.generated, not from this
+        # generator's own step() events: continuous batching means
+        # other drivers (run()/stream()/a sibling stream_request)
+        # may decode this request's tokens — append-only list, so
+        # the cursor never misses one, whoever produced it
+        sent = 0
+        try:
+            while True:
+                while sent < len(req.generated):
+                    yield req.generated[sent]
+                    sent += 1
+                if req.done or not self._has_loop_work():
+                    break
+                self.step()
+            state["exhausted"] = True
+        finally:
+            flag()
+
     def run(self):
         """Drain everything; returns ``{request_id: full token
-        list}`` for every request that finished during this call
-        (failed requests are included with their partial output —
-        check ``request.state``)."""
+        list}`` for every request that reached a terminal state
+        during this call (failed / expired / cancelled ones included
+        with their partial output — check ``request.state``)."""
         for _ev in self.stream():
             pass
         done, self._completed = self._completed, []
         return {req.id: req.tokens for req in done}
+
+    def drain(self, run=True):
+        """Graceful shutdown, phase one: stop admission (subsequent
+        ``submit()`` calls shed with ``ServeRejectedError``), keep
+        queued requests queued — they belong to :meth:`snapshot` —
+        and, with ``run=True``, finish the currently RUNNING batch.
+        Returns the terminal requests collected since the last
+        ``run()``/``drain()`` as ``{id: tokens}``.  Idempotent."""
+        self._latch_drain()
+        if run:
+            while self._sched.any_running():
+                self.step()
+        done, self._completed = self._completed, []
+        return {req.id: req.tokens for req in done}
+
+    def _latch_drain(self):
+        """Latch admission off (idempotent): counter + the one
+        ``serve_drain`` event fire on the first latch, however it
+        happens — ``drain()`` or the SIGTERM handler.  Touches no
+        ``_completed`` state, so it is safe from a signal handler
+        interrupting ``run()``."""
+        if self._draining:
+            return
+        self._draining = True
+        self._m_drains.inc()
+        tracing.trace_event(
+            "serve_drain", engine=self.engine_id,
+            running=self._sched.n_running(),
+            queue_depth=len(self._sched.waiting))
+
+    # -------------------------------------------- snapshot / restore
+    def _snapshot_request(self, req, now):
+        """One in-flight request's resumable state.  Deadlines are
+        persisted as REMAINING seconds (monotonic stamps are
+        meaningless in another process); a negative remainder means
+        the restored request expires on its first iteration, which
+        is exactly the SLO truth."""
+        # observability parity across the crash: a QUEUED request's
+        # wait segment is still open — close it into the persisted
+        # total exactly like every terminal path does, or the
+        # restored lifecycle under-reports its pre-crash wait
+        wait = req.queue_wait_s
+        if req.state == QUEUED:
+            wait += now - req.enqueue_ts
+        return {
+            "id": req.id,
+            "prompt": list(req.prompt),
+            "generated": list(req.generated),
+            "max_new_tokens": req.max_new_tokens,
+            "eos_id": req.eos_id,
+            "queue_wait_s": wait,
+            "prefill_s": req.prefill_s,
+            "preemptions": req.preemptions,
+            "ttft_done": req.first_token_ts is not None,
+            "ttft_remaining_s": (
+                req.ttft_deadline_ts - now
+                if req.ttft_deadline_ts is not None else None),
+            "deadline_remaining_s": (
+                req.deadline_ts - now
+                if req.deadline_ts is not None else None),
+        }
+
+    def snapshot(self, path=None):
+        """Persist every in-flight request (running by admission
+        order first, then the waiting queue in order) so a fresh
+        engine can :meth:`restore` them.  A request is fully
+        reconstructible from prompt + generated tokens: greedy
+        recompute (the same property preemption relies on) makes the
+        restored continuation token-identical.
+
+        Returns the snapshot dict; with ``path`` it is also written
+        via ``resilience.atomic_save`` (+ CRC32 sidecar), so a
+        SIGTERM-time snapshot a reader observes is whole or absent,
+        never torn.  Safe to call from a signal handler interrupting
+        the engine thread: only host-side Python state is read, each
+        request's ``generated`` list is append-only, and a request
+        the signal caught mid-transit between queue and slot
+        (``_in_transit``) is captured too — it is in neither
+        ``waiting`` nor ``slots`` during that window."""
+        now = time.monotonic()
+        running = sorted(
+            (r for r in list(self._sched.slots) if r is not None),
+            key=lambda r: r.admit_seq)
+        transit = self._in_transit
+        # index-walk, not iteration/safe_list: client threads only
+        # APPEND to the waiting deque (removal is engine-loop-only,
+        # and a signal handler freezes that very thread), so walking
+        # by index yields a consistent snapshot where an iterator
+        # would raise on a concurrent append — and a degrade-to-
+        # empty fallback would silently drop the whole queue from
+        # the crash-resume file
+        waiting = []
+        i = 0
+        while True:
+            try:
+                waiting.append(self._sched.waiting[i])
+            except IndexError:
+                break
+            i += 1
+        # cancel-flagged requests are excluded (the client already
+        # hung up — a restore must not resurrect them); the id
+        # dedup covers a transit pointer that already landed back
+        # in a slot or the queue
+        reqs, seen = [], set()
+        # the _live straggler sweep is the safety net: if the engine
+        # loop runs on a DIFFERENT thread than this snapshot (not
+        # the documented signal-handler-freezes-the-loop case), a
+        # concurrently-popped request can be missing from all three
+        # views above for an instant — _live holds every non-
+        # terminal request regardless, so none can vanish from the
+        # crash-resume file (it merely lands at the queue's tail)
+        for r in (list(running)
+                  + ([transit] if transit is not None else [])
+                  + waiting
+                  + list(self._live.copy().values())):
+            if r.id in seen or r.done or r.cancel_requested:
+                continue
+            seen.add(r.id)
+            reqs.append(self._snapshot_request(r, now))
+        snap = {
+            "version": SNAPSHOT_VERSION,
+            "engine": {"max_batch": self.max_batch,
+                       "block_size": self.block_size,
+                       "num_blocks": self.num_blocks,
+                       "prefix_cache": self.cache.enabled,
+                       "quantize": ("int8" if self.quantized
+                                    else "off"),
+                       "max_len": self.model._max_len},
+            "next_id": self._next_id,
+            "requests": reqs,
+        }
+        tracing.trace_event("serve_snapshot", engine=self.engine_id,
+                            requests=len(reqs),
+                            path=str(path) if path else None)
+        if path is not None:
+            import pickle
+            resilience.atomic_save(
+                path, lambda f: pickle.dump(snap, f))
+        return snap
+
+    @classmethod
+    def restore(cls, model, snapshot, **engine_kw):
+        """Build a fresh engine and re-queue every request of a
+        :meth:`snapshot` (a path, or the dict itself).  Restored
+        requests continue by greedy recompute — re-admission
+        prefills ``prompt + generated``, exactly the preemption
+        path — so completed outputs are token-identical to an
+        uninterrupted run.  Engine geometry defaults to the
+        snapshot's; explicit ``engine_kw`` overrides win, and a
+        request the new geometry can never serve fails loudly at
+        admission (typed, per-request) instead of hanging the
+        schedule."""
+        if isinstance(snapshot, (str, os.PathLike)):
+            import pickle
+            path = os.fspath(snapshot)
+            snapshot = resilience.decode_or_corrupt(
+                path, lambda: pickle.loads(
+                    resilience.read_validated_bytes(path)))
+        if not isinstance(snapshot, dict) or \
+                snapshot.get("version") != SNAPSHOT_VERSION or \
+                "requests" not in snapshot:
+            raise resilience.CheckpointCorruptError(
+                "not a serving snapshot (or an incompatible "
+                f"version): {snapshot!r:.80}")
+        cfg = snapshot.get("engine", {})
+        for key in ("max_batch", "block_size", "num_blocks",
+                    "prefix_cache", "quantize"):
+            if cfg.get(key) is not None:
+                engine_kw.setdefault(key, cfg[key])
+        eng = cls(model, **engine_kw)
+        now = time.monotonic()
+        complete = []   # retired OUTSIDE the lock: _finalize takes it
+        with eng._submit_lock:
+            for entry in snapshot["requests"]:
+                req = Request(int(entry["id"]), entry["prompt"],
+                              entry["max_new_tokens"],
+                              eos_id=entry.get("eos_id"))
+                req.generated = [int(t)
+                                 for t in entry.get("generated", [])]
+                req.queue_wait_s = float(
+                    entry.get("queue_wait_s", 0.0))
+                req.prefill_s = float(entry.get("prefill_s", 0.0))
+                req.preemptions = int(entry.get("preemptions", 0))
+                rem = entry.get("deadline_remaining_s")
+                if rem is not None:
+                    req.deadline_ts = now + float(rem)
+                rem = entry.get("ttft_remaining_s")
+                # a request whose first token shipped pre-crash met
+                # its TTFT SLO; the re-prefill must not re-arm it —
+                # and must not re-emit serve_first_token or observe
+                # a second TTFT sample (lifecycle parity: one first
+                # token per request, ever)
+                if entry.get("ttft_done"):
+                    req.first_token_ts = now
+                    req.last_token_ts = now
+                elif rem is not None:
+                    req.ttft_deadline_ts = now + float(rem)
+                tracing.trace_event(
+                    "serve_enqueue", rid=req.id,
+                    engine=eng.engine_id,
+                    prompt_tokens=len(req.prompt),
+                    max_new_tokens=req.max_new_tokens,
+                    restored=True,
+                    generated_tokens=len(req.generated))
+                eng._prof_async("b", "request", req)
+                eng._prof_async("b", "queue_wait", req)
+                eng._live[req.id] = req
+                eng._m_requests.inc()
+                # a snapshot can catch a request BETWEEN its last
+                # generated token and its same-iteration retirement
+                # (req.done latches at _retire): that request is
+                # already complete — re-queueing it would decode
+                # one token past its budget/EOS and break the
+                # token-identical resume guarantee
+                if (len(req.generated) >= req.max_new_tokens
+                        or (req.eos_id is not None and req.generated
+                            and req.generated[-1] == req.eos_id)):
+                    complete.append(req)
+                    continue
+                if req.ttft_deadline_ts is not None \
+                        or req.deadline_ts is not None:
+                    eng._deadlines_armed += 1
+                    eng._deadline_next = min(eng._deadline_next,
+                                             eng._next_deadline(req))
+                eng._sched.add(req)
+            eng._next_id = max(
+                int(snapshot.get("next_id", 0)),
+                max((r["id"] for r in snapshot["requests"]),
+                    default=-1) + 1)
+        for req in complete:
+            eng._retire(req)    # exactly-one-terminal parity holds
+        tracing.trace_event("serve_restore", engine=eng.engine_id,
+                            requests=len(snapshot["requests"]))
+        return eng
+
+    def install_sigterm(self, snapshot_path, drain=True):
+        """Wire SIGTERM to snapshot-then-drain: the handler writes
+        an atomic :meth:`snapshot` of every in-flight request to
+        ``snapshot_path``, then latches :meth:`drain` mode so the
+        loop finishes the running batch and ``run()``/``stream()``
+        return (the process exits normally — the signal is consumed).
+        With ``drain=False`` the previous SIGTERM disposition runs
+        instead right after the snapshot (default disposition:
+        process dies — the crash-resume flavor; a fresh process
+        :meth:`restore`\\ s the snapshot).
+
+        Main-thread only (signal.signal's rule); returns False
+        when it cannot install.  Chains whatever PYTHON handler was
+        there — tracing.install_signal_dump's post-mortem, another
+        engine's snapshot hook — on every path; with ``drain=True``
+        only the default-disposition re-raise is suppressed (it
+        would kill the process drain means to let exit — though a
+        chained handler that itself escalates still terminates,
+        with snapshot and dump on disk).  Falls back to the
+        previous disposition entirely once the engine is garbage-
+        collected (the handler only holds a weakref; it must never
+        consume SIGTERM on behalf of an engine that no longer
+        exists)."""
+        import signal as _signal
+        if threading.current_thread() is not threading.main_thread():
+            return False
+        prev = _signal.getsignal(_signal.SIGTERM)
+        eng_ref = weakref.ref(self)
+
+        def handler(num, frame):
+            eng = eng_ref()
+            if eng is not None:
+                try:
+                    eng.snapshot(snapshot_path)
+                except Exception:   # a torn dump must not mask the
+                    pass            # signal's actual handling
+                try:
+                    eng._latch_drain()  # drains_total counts SIGTERM
+                except Exception:       # the latch must hold even if
+                    eng._draining = True    # telemetry raises
+                if drain:
+                    # consume the signal for THIS engine's graceful
+                    # exit, but still run any chained Python handler
+                    # first — another engine's snapshot hook or
+                    # tracing's post-mortem dump must not be
+                    # silenced by whoever installed last.  Only the
+                    # default-disposition re-raise is suppressed
+                    # (that would kill the process drain means to
+                    # let exit); a chained handler that itself
+                    # escalates leaves the snapshot + dump behind —
+                    # the crash-resume flavor with artifacts.
+                    if callable(prev):
+                        prev(num, frame)
+                    return
+                # drain=False: fall through to the previous
+                # disposition right after the snapshot
+            # engine already gone (or drain=False): the previous
+            # disposition must run — a dead weakref consuming every
+            # SIGTERM would make the process unkillable by anything
+            # short of SIGKILL
+            if callable(prev):
+                prev(num, frame)
+            elif prev == _signal.SIG_IGN:
+                return
+            else:
+                _signal.signal(num, _signal.SIG_DFL)
+                _signal.raise_signal(num)
+
+        try:
+            _signal.signal(_signal.SIGTERM, handler)
+        except (ValueError, OSError):
+            return False
+        return True
 
     # ------------------------------------------------------ internals
     def _alloc(self, n):
@@ -368,14 +921,32 @@ class ServingEngine:
         blocks)."""
         import jax
         import jax.numpy as jnp
+        if self._draining:
+            return      # drain(): queued requests belong to snapshot()
         while self._sched.has_waiting():
             slot = self._sched.free_slot()
             if slot is None:
                 return
+            # publish to the snapshot pointer BEFORE popping: a
+            # signal landing between the two statements sees the
+            # request in both places (id-dedup) — after a bare pop
+            # it would be in neither.  Visible until placed,
+            # requeued, or terminal (terminals filter on req.done).
+            self._in_transit = self._sched.waiting[0]
             req = self._sched.pop_waiting()
             try:
                 resilience.inject("serve", "request")
             except resilience.TransientError as exc:
+                self._fail(req, exc)
+                continue
+            try:
+                # re-check at admission: a snapshot restored into a
+                # smaller pool/context must fail THAT request loudly,
+                # not hang the schedule (submit() already vets fresh
+                # submissions; preemption cannot grow the bound)
+                self._check_servable(len(req.prompt),
+                                     req.max_new_tokens)
+            except RequestTooLargeError as exc:
                 self._fail(req, exc)
                 continue
             toks = req.tokens
@@ -387,6 +958,7 @@ class ServingEngine:
                 if matched:
                     self.pool.free(matched)     # release the match
                 self._sched.push_front(req)
+                self._in_transit = None
                 if not self._sched.any_running():
                     raise SchedulingError(
                         f"request {req.id} needs {need} fresh "
@@ -404,6 +976,7 @@ class ServingEngine:
             self._m_misses.inc(len(toks) - n_cached)
             req.block_ids = matched + fresh
             self._sched.place(req, slot)
+            self._in_transit = None
             tracing.trace_event(
                 "serve_admit", rid=req.id, engine=self.engine_id,
                 slot=slot,
@@ -466,11 +1039,16 @@ class ServingEngine:
                 except BlockPoolExhausted:
                     victim = self._sched.latest_running()
                     if victim is req and self._sched.n_running() == 1:
-                        raise SchedulingError(
+                        # the pool cannot hold this one sequence:
+                        # fail THE REQUEST loudly (typed, terminal,
+                        # blocks freed) instead of raising out of
+                        # step() or — worse — spinning forever
+                        self._fail(req, SchedulingError(
                             "block pool exhausted with a single "
                             "running request — the pool cannot hold "
                             "one full sequence; raise "
-                            "MXTPU_SERVE_NUM_BLOCKS")
+                            "MXTPU_SERVE_NUM_BLOCKS"))
+                        break
                     self._preempt(victim)
                     if victim is req:
                         break               # we preempted ourselves
@@ -481,6 +1059,7 @@ class ServingEngine:
         prompt+generated (cheap again once the prefix cache holds
         the shared blocks)."""
         freed = len(req.block_ids)
+        self._in_transit = req      # out of the slot, not yet queued
         self._sched.clear(req)
         if req.block_ids:
             self.pool.free(req.block_ids)
@@ -498,15 +1077,27 @@ class ServingEngine:
             preemptions=req.preemptions)
         self._prof_async("e", "decode", req)
         self._sched.push_front(req)
+        self._in_transit = None
         tracing.trace_event("serve_requeue", rid=req.id,
                             engine=self.engine_id,
                             queue_depth=len(self._sched.waiting))
         self._prof_async("b", "queue_wait", req)
 
     def _decode_once(self, events):
-        """One batched decode step + the per-iteration token read."""
+        """One batched decode step + the per-iteration token read.
+
+        Watchdog: with ``MXTPU_SERVE_STEP_TIMEOUT`` > 0, an
+        iteration whose decode (injection included — serve:step:N:
+        hang is the test vector) runs past the budget logs loudly,
+        records ``serve_step_overrun`` and dumps the flight recorder
+        (``MXTPU_TRACE_DUMP``).  Detection, not interruption: a
+        wedged device call cannot be cancelled portably — converting
+        the overrun into a post-mortem is this layer's job, killing
+        the process is the heartbeat monitor's."""
         import jax
         import jax.numpy as jnp
+        t_step = time.monotonic()
+        resilience.inject("serve", "step")
         B, MB = self.max_batch, self.max_blocks
         tokens = np.zeros(B, np.int32)
         npast = np.zeros(B, np.int32)
@@ -528,6 +1119,18 @@ class ServingEngine:
             # already serializes the loop; waiting on the donated
             # pools too keeps the NEXT dispatch off the slow path
             jax.block_until_ready(self._kpools)
+        dt_step = time.monotonic() - t_step
+        if self.step_timeout > 0 and dt_step > self.step_timeout:
+            tracing.trace_event(
+                "serve_step_overrun", engine=self.engine_id,
+                seconds=round(dt_step, 6), budget=self.step_timeout,
+                running=self._sched.n_running())
+            get_logger().warning(
+                "serving: decode step took %.3fs against the %.3fs "
+                "budget (MXTPU_SERVE_STEP_TIMEOUT); flight-recorder "
+                "post-mortem follows when MXTPU_TRACE_DUMP is set",
+                dt_step, self.step_timeout)
+            tracing.dump_on_fault("serve_step_overrun")
         toks = np.asarray(nxt)  # sync-ok: the per-iteration token read
         for i, req in enumerate(list(slots)):
             if req is None:
@@ -560,14 +1163,60 @@ class ServingEngine:
                 or (req.eos_id is not None and tok == req.eos_id)):
             self._retire(req)
 
-    def _retire(self, req):
+    # ----------------------------------------------- terminal paths
+    def _close_wait(self, req, now):
+        """Close the open queue-wait segment of a QUEUED request
+        (observability parity: every terminal path records its wait,
+        however it died).  Returns the request's open async phase —
+        ``queue_wait`` for queued requests, ``decode`` for running
+        ones (admitted requests opened decode at prefill end)."""
+        if req.state == QUEUED:
+            wait = now - req.enqueue_ts
+            req.queue_wait_s += wait
+            self._h_wait.observe(wait)
+            return "queue_wait"
+        return "decode"
+
+    def _release(self, req, now):
+        """Shared terminal release: slot cleared and every pool
+        block freed in the SAME iteration the terminal was decided,
+        so the next admission sees the memory."""
+        open_phase = self._close_wait(req, now)
         self._sched.clear(req)
         if req.block_ids:
             self.pool.free(req.block_ids)
         req.block_ids = []
-        req.state = FINISHED
-        req.finish_ts = time.monotonic()
+        req.finish_ts = now
+        return open_phase
+
+    def _finalize(self, req):
+        """Terminal bookkeeping every exit path funnels through:
+        exactly one summary, one completed entry, one per-state
+        count, and the reap arm-counters released."""
+        with self._submit_lock:
+            self._live.pop(req.id, None)
+            # release only counts cancel() actually took: the
+            # stream-abandon flag never bumps the counter, and an
+            # uncounted decrement here would steal — and starve —
+            # another request's pending cancel behind the reap gate
+            if req.cancel_counted and self._cancels_pending > 0:
+                self._cancels_pending -= 1
+            if (req.ttft_deadline_ts is not None
+                    or req.deadline_ts is not None) \
+                    and self._deadlines_armed > 0:
+                self._deadlines_armed -= 1
+            # under the lock: _reject() bumps the same dict from
+            # client threads — racing read-modify-writes would
+            # silently lose terminal counts
+            self._terminal_counts[req.state] = \
+                self._terminal_counts.get(req.state, 0) + 1
         self._completed.append(req)
+        self._req_summaries.append(self._request_summary(req))
+
+    def _retire(self, req):
+        now = time.monotonic()
+        self._release(req, now)
+        req.state = FINISHED
         tracing.trace_event(
             "serve_retire", rid=req.id, engine=self.engine_id,
             tokens_generated=len(req.generated),
@@ -575,10 +1224,12 @@ class ServingEngine:
             queue_wait_s=round(req.queue_wait_s, 6),
             prefill_s=round(req.prefill_s, 6))
         self._terminal_async(req, "decode")
-        self._req_summaries.append(self._request_summary(req))
+        self._finalize(req)
 
     def _fail(self, req, exc):
-        """Evict a poisoned request without touching batchmates.
+        """Evict a poisoned or unservable request without touching
+        batchmates (queued requests close their wait segment, running
+        ones their decode phase).
 
         Observability parity with retirement: the queue wait is
         recorded (an admission-time eviction would otherwise leave
@@ -590,30 +1241,133 @@ class ServingEngine:
             "serving: evicting request %s after injected/terminal "
             "fault: %s", req.id, exc)
         now = time.monotonic()
-        # _fail only fires on requests popped from the queue (fresh
-        # or requeued-after-preemption), so a queue-wait segment is
-        # always open here — close it, like admission does
-        wait = now - req.enqueue_ts
-        req.queue_wait_s += wait
-        self._h_wait.observe(wait)
-        self._sched.clear(req)
-        if req.block_ids:
-            self.pool.free(req.block_ids)
-        req.block_ids = []
+        open_phase = self._release(req, now)
         req.state = FAILED
         req.error = exc
-        req.finish_ts = now
         self._m_evict.inc()
-        self._completed.append(req)
         tracing.trace_event(
             "serve_evict", rid=req.id, engine=self.engine_id,
             error=str(exc),
             tokens_generated=len(req.generated),
             queue_wait_s=round(req.queue_wait_s, 6),
             preemptions=req.preemptions)
-        self._terminal_async(req, "queue_wait")
-        self._req_summaries.append(self._request_summary(req))
+        self._terminal_async(req, open_phase)
+        self._finalize(req)
         tracing.dump_on_fault("serving_eviction")
+
+    def _expire(self, req, why, now):
+        """Terminal ``expired``: the request's TTFT or total
+        deadline passed.  Partial output is retained on the handle;
+        ``req.error`` carries a typed DeadlineExceededError."""
+        open_phase = self._release(req, now)
+        req.state = EXPIRED
+        req.error = resilience.DeadlineExceededError(
+            f"serving request {req.id} missed its {why} deadline "
+            f"after {len(req.generated)} generated token(s)")
+        self._m_expired.inc()
+        tracing.trace_event(
+            "serve_expire", rid=req.id, engine=self.engine_id,
+            why=why, tokens_generated=len(req.generated),
+            queue_wait_s=round(req.queue_wait_s, 6),
+            preemptions=req.preemptions)
+        self._terminal_async(req, open_phase)
+        self._finalize(req)
+
+    def _cancel_now(self, req, now):
+        """Terminal ``cancelled``: honor a client cancellation.
+        Partial output retained; blocks freed this iteration."""
+        open_phase = self._release(req, now)
+        req.state = CANCELLED
+        self._m_cancelled.inc()
+        tracing.trace_event(
+            "serve_cancel", rid=req.id, engine=self.engine_id,
+            tokens_generated=len(req.generated),
+            queue_wait_s=round(req.queue_wait_s, 6),
+            preemptions=req.preemptions)
+        self._terminal_async(req, open_phase)
+        self._finalize(req)
+
+    @staticmethod
+    def _verdict(req, now):
+        """Why a live request must leave the engine now, or None.
+        Cancellation wins over expiry (the client already hung up);
+        the TTFT deadline only binds before the first token."""
+        if req.cancel_requested:
+            return "cancel"
+        if req.deadline_ts is not None and now >= req.deadline_ts:
+            return "total"
+        if req.first_token_ts is None \
+                and req.ttft_deadline_ts is not None \
+                and now >= req.ttft_deadline_ts:
+            return "ttft"
+        return None
+
+    @staticmethod
+    def _next_deadline(req):
+        """Earliest future stamp at which ``req`` could expire, or
+        +inf.  A stale TTFT stamp after the first token only makes
+        the next sweep fire early — the sweep re-verdicts, so early
+        is harmless and late is impossible."""
+        nxt = float("inf")
+        if req.deadline_ts is not None:
+            nxt = req.deadline_ts
+        if req.first_token_ts is None \
+                and req.ttft_deadline_ts is not None:
+            nxt = min(nxt, req.ttft_deadline_ts)
+        return nxt
+
+    def _reap(self):
+        """Honor pending cancellations and blown deadlines — queued
+        and running alike — freeing blocks/slots in the same
+        iteration.  Two guards keep this off the decode hot path:
+        the arm counters (no deadline armed, no cancel pending = one
+        integer test) and the earliest-armed-deadline stamp (armed
+        but not yet due = one clock read).  Expired/cancelled queued
+        requests are REMOVED in place — never pop-all-and-re-push,
+        whose empty-queue window a concurrent ``submit()`` admission
+        check or a SIGTERM-time ``snapshot()`` would observe."""
+        flagged = self._abandon_flagged
+        if not (self._cancels_pending or flagged
+                or self._deadlines_armed):
+            return
+        now = time.monotonic()
+        if not (self._cancels_pending or flagged) \
+                and now < self._deadline_next:
+            return
+        self._abandon_flagged = False
+        with self._submit_lock:
+            # reset BEFORE the walk: a submit() arming an earlier
+            # deadline mid-sweep mins into this, and the final store
+            # below mins back — neither update can be lost
+            self._deadline_next = float("inf")
+        nxt = float("inf")
+        # safe_list: a client thread's submit() may append while we
+        # walk (a bare list() of a mutating deque raises); removal
+        # serializes against that append under the submit lock
+        for req in tracing.safe_list(self._sched.waiting):
+            why = self._verdict(req, now)
+            if why is None:
+                nxt = min(nxt, self._next_deadline(req))
+                continue
+            with self._submit_lock:
+                removed = self._sched.remove_waiting(req)
+            if removed:
+                if why == "cancel":
+                    self._cancel_now(req, now)
+                else:
+                    self._expire(req, why, now)
+        for req in list(self._sched.slots):
+            if req is None:
+                continue
+            why = self._verdict(req, now)
+            if why == "cancel":
+                self._cancel_now(req, now)
+            elif why is not None:
+                self._expire(req, why, now)
+            else:
+                nxt = min(nxt, self._next_deadline(req))
+        with self._submit_lock:
+            self._deadline_next = min(self._deadline_next, nxt)
 
     # -------------------------------------------------- observability
     def _prof_async(self, ph, name, req):
@@ -643,10 +1397,12 @@ class ServingEngine:
     def _terminal_async(self, req, open_phase):
         """Close a request's open async phases at its terminal
         transition.  ``open_phase`` is the phase still open at that
-        point: always ``decode`` for retirement (opened at the last
-        admission), always ``queue_wait`` for eviction — ``_fail``
-        only fires on requests popped from the queue, including
-        preempted ones whose requeue re-opened the wait."""
+        point: ``decode`` for retirement (opened at the last
+        admission) and for any terminal that catches the request
+        RUNNING (expiry, cancellation, the single-runner pool-
+        exhaustion failure in ``_grow``); ``queue_wait`` for a
+        terminal that catches it QUEUED — ``_close_wait`` decides
+        from the request's state."""
         self._prof_async("e", open_phase, req)
         self._prof_async("e", "request", req)
 
@@ -689,4 +1445,11 @@ class ServingEngine:
             "batch_occupancy":
                 self._sched.n_running() / self.max_batch,
             "pool_utilization": self.pool.utilization(),
+            # SLO/survival view: how every request ended
+            # ('rejected' counts submissions shed at the door),
+            # plus the admission controller's live pressure
+            "terminal_counts": dict(self._terminal_counts),
+            "queue_depth": len(self._sched.waiting),
+            "queued_tokens": self._sched.queued_tokens,
+            "draining": self._draining,
         }

@@ -23,21 +23,55 @@ Policy (docs/serving.md):
 The scheduler is pure host-side bookkeeping; device state (pools,
 compiled steps) lives in engine.py.
 """
+import threading
 import time
 from collections import deque
 
-__all__ = ["Request", "Scheduler", "SchedulingError",
-           "QUEUED", "RUNNING", "FINISHED", "FAILED"]
+__all__ = ["Request", "Scheduler", "ServingError", "SchedulingError",
+           "ServeRejectedError", "RequestTooLargeError",
+           "QUEUED", "RUNNING", "FINISHED", "FAILED", "EXPIRED",
+           "CANCELLED", "TERMINAL_STATES"]
 
 QUEUED = "queued"
 RUNNING = "running"
 FINISHED = "finished"
 FAILED = "failed"
+# SLO/survival terminals (docs/serving.md "SLOs, shedding, drain"):
+# a request whose ttft/total deadline passed before it could finish,
+# and one the client cancelled (engine.cancel / abandoned stream).
+# Both free their blocks and slot in the iteration that detects them.
+EXPIRED = "expired"
+CANCELLED = "cancelled"
+
+TERMINAL_STATES = (FINISHED, FAILED, EXPIRED, CANCELLED)
 
 
-class SchedulingError(RuntimeError):
+class ServingError(RuntimeError):
+    """Base class for serving-tier failures (typed so traffic code
+    can tell the serving layer's own verdicts from model errors)."""
+
+
+class SchedulingError(ServingError):
     """The schedule cannot make progress (e.g. a single request
     needs more blocks than the whole pool holds)."""
+
+
+class ServeRejectedError(ServingError):
+    """``submit()`` refused the request at admission control: the
+    bounded wait queue (``MXTPU_SERVE_QUEUE_LIMIT``) or queued
+    prompt-token budget (``MXTPU_SERVE_QUEUE_TOKENS``) is full, or
+    the engine is draining.  Shedding at the door keeps admitted
+    requests' latency bounded instead of letting the queue grow into
+    unbounded TTFT collapse — callers should retry elsewhere/later."""
+
+
+class RequestTooLargeError(ServingError, ValueError):
+    """The request can never be served by this engine: its prompt +
+    ``max_new_tokens`` exceeds the model context or needs more KV
+    blocks than the whole pool holds.  Raised loudly at ``submit()``
+    (and re-checked at admission for snapshot-restored requests)
+    instead of leaving the request queued forever.  Also a
+    ValueError so legacy size-validation handlers keep working."""
 
 
 class Request:
@@ -54,7 +88,9 @@ class Request:
                  "admit_seq", "preemptions", "error", "logits",
                  "submit_ts", "admit_ts", "first_token_ts",
                  "last_token_ts", "finish_ts", "enqueue_ts",
-                 "queue_wait_s", "prefill_s", "last_slot")
+                 "queue_wait_s", "prefill_s", "last_slot",
+                 "ttft_deadline_ts", "deadline_ts",
+                 "cancel_requested", "cancel_counted")
 
     def __init__(self, req_id, prompt, max_new_tokens, eos_id=None):
         self.id = req_id
@@ -86,10 +122,30 @@ class Request:
         # events (after clear() nulls .slot) and re-admissions into a
         # different slot keep rendering on the same track
         self.last_slot = None
+        # SLO state: absolute MONOTONIC expiry stamps (None = no
+        # deadline).  ttft_deadline_ts stops binding once the first
+        # token lands (the stamp itself stays set — the engine's
+        # armed-deadline accounting counts it until terminal);
+        # deadline_ts bounds the whole request.  The engine's reap
+        # sweep enforces both; snapshot/restore persists the
+        # REMAINING seconds, never the stamps (a monotonic clock
+        # does not survive the process).
+        self.ttft_deadline_ts = None
+        self.deadline_ts = None
+        # set by engine.cancel() from any thread; honored (terminal
+        # state CANCELLED, blocks freed) at the next engine
+        # iteration.  cancel_counted marks a cancel that bumped the
+        # engine's _cancels_pending counter — the lock-free
+        # stream-abandon flag deliberately does NOT, and _finalize
+        # must only release counts that were actually taken (an
+        # uncounted decrement would starve another request's
+        # pending cancel behind the reap gate)
+        self.cancel_requested = False
+        self.cancel_counted = False
 
     @property
     def done(self):
-        return self.state in (FINISHED, FAILED)
+        return self.state in TERMINAL_STATES
 
     @property
     def tokens(self):
@@ -104,7 +160,17 @@ class Request:
 
 
 class Scheduler:
-    """Waiting queue + fixed slot array for ``max_batch`` runners."""
+    """Waiting queue + fixed slot array for ``max_batch`` runners.
+
+    ``queued_tokens`` tracks the summed token length (prompt +
+    generated-so-far) of everything in the waiting queue — the
+    admission controller's queued-prompt-token budget
+    (``MXTPU_SERVE_QUEUE_TOKENS``) reads it without walking the
+    queue on every ``submit()``.  Its updates take a private lock:
+    client threads add (under the engine's submit lock) while the
+    engine loop pops, and a lost read-modify-write would drift the
+    counter for the rest of the process — shedding against a queue
+    that is not actually full (or never shedding again)."""
 
     def __init__(self, max_batch):
         if max_batch < 1:
@@ -112,18 +178,46 @@ class Scheduler:
         self.max_batch = int(max_batch)
         self.slots = [None] * self.max_batch
         self.waiting = deque()
+        self.queued_tokens = 0
+        self._tok_lock = threading.Lock()
         self._admit_counter = 0
 
     # ------------------------------------------------------- queue
     def add(self, req):
         self.waiting.append(req)
+        with self._tok_lock:
+            self.queued_tokens += len(req.prompt) + len(req.generated)
 
     def push_front(self, req):
-        """Re-queue at the head (preemption / failed admission)."""
+        """Re-queue at the head (preemption / failed admission).
+        Bypasses admission control by design: a preempted request
+        was already admitted once — shedding it now would turn
+        memory pressure into a client-visible failure."""
         self.waiting.appendleft(req)
+        with self._tok_lock:
+            self.queued_tokens += len(req.prompt) + len(req.generated)
 
     def pop_waiting(self):
-        return self.waiting.popleft() if self.waiting else None
+        if not self.waiting:
+            return None
+        req = self.waiting.popleft()
+        with self._tok_lock:
+            self.queued_tokens -= len(req.prompt) + len(req.generated)
+        return req
+
+    def remove_waiting(self, req):
+        """Remove one specific queued request in place (the reap
+        sweep's deadline/cancel path).  Removal — not pop-all-and-
+        re-push — so the queue never transits an empty state a
+        concurrent ``submit()`` admission check or a SIGTERM-time
+        ``snapshot()`` could observe.  Returns False when absent."""
+        try:
+            self.waiting.remove(req)
+        except ValueError:
+            return False
+        with self._tok_lock:
+            self.queued_tokens -= len(req.prompt) + len(req.generated)
+        return True
 
     def has_waiting(self):
         return bool(self.waiting)

@@ -38,6 +38,7 @@ nothing is buffered, no locks are taken.
 
 The recorder's contents dump automatically (atomic, JSONL) on
 ``DivergedError`` / ``DataPipelineError`` / serving eviction faults
+/ serving decode-step watchdog overruns (``MXTPU_SERVE_STEP_TIMEOUT``)
 and on SIGTERM/SIGUSR1 — but only when ``MXTPU_TRACE_DUMP`` names a
 path; unset (the default) keeps faults side-effect free.  Event
 *names* are governed like metric names: every literal passed to
@@ -113,18 +114,33 @@ class FlightRecorder:
     def record(self, event, **fields):
         fields["event"] = event
         fields["ts"] = time.time()
-        with self._lock:
+        # timeout-acquire, like _snapshot's signal path: a SIGTERM
+        # handler's own producers (serve_snapshot/serve_drain) may
+        # run on the very thread interrupted mid-record() with the
+        # lock held — a blocking acquire would deadlock the handler
+        # the instant before it writes the crash-resume file.  One
+        # second never fires under real contention (the hold is a
+        # few dict ops); on timeout the event is dropped and
+        # counted, which beats hanging the process.
+        if not self._lock.acquire(timeout=1.0):
+            self._dropped += 1      # best-effort count (unlocked)
+            return
+        try:
             fields["seq"] = next(self._seq)
             if len(self._buf) == self._buf.maxlen:
                 self._dropped += 1  # ring bound evicts the oldest
             self._buf.append(fields)
             self.recorded += 1
+        finally:
+            self._lock.release()
 
     @property
     def dropped(self):
-        """Events evicted by the ring *bound* so far.  Deliberate
-        ``clear()`` calls do not count — a post-mortem's drop count
-        must mean 'history the ring was too small to keep'."""
+        """Events evicted by the ring *bound* so far (plus the
+        vanishingly rare producer that gave up its lock-timeout in
+        a signal-deadlock window).  Deliberate ``clear()`` calls do
+        not count — a post-mortem's drop count must mean 'history
+        the ring could not keep'."""
         return self._dropped
 
     def _snapshot(self, lock_timeout=None):

@@ -105,6 +105,36 @@ register_env("MXTPU_SERVE_QUANT", str, "off",
              "(per-output-channel symmetric, fp32 scales, "
              "dequantized inside the compiled step)")
 
+# Serving SLO / survival layer (docs/serving.md "SLOs, shedding,
+# and drain").  All deadline arithmetic is monotonic-clock
+# (lint-enforced); 0 disables each knob.
+register_env("MXTPU_SERVE_TTFT_DEADLINE", float, 0.0,
+             "default per-request time-to-first-token deadline (s) "
+             "for ServingEngine.submit; a request still waiting for "
+             "its first token past this expires (terminal state "
+             "'expired', blocks freed same iteration); 0 disables")
+register_env("MXTPU_SERVE_DEADLINE", float, 0.0,
+             "default per-request total deadline (s): submit -> "
+             "last token; an in-flight request past it expires with "
+             "its partial output retained; 0 disables")
+register_env("MXTPU_SERVE_QUEUE_LIMIT", int, 0,
+             "bounded serving wait queue: submit() raises "
+             "ServeRejectedError once this many requests are "
+             "queued, shedding load at the door instead of letting "
+             "queue wait grow without bound; 0 = unbounded")
+register_env("MXTPU_SERVE_QUEUE_TOKENS", int, 0,
+             "queued prompt-token budget: submit() rejects when the "
+             "waiting queue's summed token length would exceed "
+             "this (bounds requeue/recompute debt, not just "
+             "request count); 0 = unbounded")
+register_env("MXTPU_SERVE_STEP_TIMEOUT", float, 0.0,
+             "decode-step watchdog budget (s): an engine iteration "
+             "whose decode step runs longer logs loudly, records a "
+             "serve_step_overrun trace event and dumps the flight "
+             "recorder (MXTPU_TRACE_DUMP) — detection, not "
+             "interruption: a wedged device call is the heartbeat "
+             "monitor's job; 0 disables")
+
 # Resilience layer (resilience.py; docs/resilience.md).
 register_env("MXTPU_COLLECTIVE_TIMEOUT", float, 600.0,
              "wall-clock deadline (s) for dist collectives; a hung "

@@ -182,7 +182,12 @@ _ERROR_COUNTERS = ("retry_attempts_total", "collective_aborts_total",
                    "sentinel_skipped_steps_total",
                    "sentinel_divergences_total", "rollbacks_total",
                    "checkpoint_fallbacks_total",
-                   "loss_scale_backoffs_total")
+                   "loss_scale_backoffs_total",
+                   # serving SLO/survival signals (docs/serving.md):
+                   # load shed at the door, deadlines blown, clients
+                   # gone, engines draining for shutdown
+                   "serving_rejected_total", "serving_expired_total",
+                   "serving_cancelled_total", "serving_drains_total")
 
 
 def _read_heartbeat(path):
@@ -250,7 +255,8 @@ def _aggregate_telemetry(snaps):
     agg = {"ranks": sorted(snaps), "counters": {}, "throughput": 0.0,
            "steps": {}, "straggler": None, "memory": {},
            "compiles": {}, "max_memory": None, "data_img_s": 0.0,
-           "data_img_s_by_rank": {}}
+           "data_img_s_by_rank": {}, "serve_queue": 0,
+           "serve_queued_tokens": 0}
     for rank, snap in snaps.items():
         for name, v in (snap.get("counters") or {}).items():
             agg["counters"][name] = agg["counters"].get(name, 0) + v
@@ -261,6 +267,12 @@ def _aggregate_telemetry(snaps):
         if ds > 0:
             agg["data_img_s"] += ds
             agg["data_img_s_by_rank"][rank] = ds
+        # serving admission pressure (docs/serving.md): queue depth
+        # and queued prompt tokens summed over this host's engines
+        agg["serve_queue"] += int(
+            gauges.get("serving_queue_depth", 0) or 0)
+        agg["serve_queued_tokens"] += int(
+            gauges.get("serving_queued_prompt_tokens", 0) or 0)
         agg["steps"][rank] = (snap.get("counters") or {}).get(
             "train_steps_total", 0)
         mem = _rank_memory(snap)
@@ -289,6 +301,9 @@ def _format_status(agg):
         parts.append(f"{agg['throughput']:.1f} samples/s")
     if agg.get("data_img_s", 0) > 0:
         parts.append(f"data: {agg['data_img_s']:.0f} img/s")
+    if agg.get("serve_queue", 0) > 0:
+        parts.append(f"serve queue: {agg['serve_queue']} req "
+                     f"({agg['serve_queued_tokens']} tok)")
     errs = [f"{n}={agg['counters'][n]}" for n in _ERROR_COUNTERS
             if agg["counters"].get(n)]
     if errs:
@@ -339,6 +354,12 @@ def _format_report(snaps):
         rank, mem = agg["max_memory"]
         lines.append(f"launch.py:   max memory: rank {rank} at "
                      f"{_fmt_bytes(mem)}")
+    if agg.get("serve_queue", 0) > 0:
+        lines.append(
+            f"launch.py:   serving queue at exit: "
+            f"{agg['serve_queue']} req "
+            f"({agg['serve_queued_tokens']} tok) — drained engines "
+            "should exit with an empty queue or a snapshot")
     lines.append("launch.py: -----------------------")
     return "\n".join(lines)
 
