@@ -30,6 +30,9 @@ CKPT_MODULES = (
     "incubator_mxnet_tpu/gluon/trainer.py",
     "incubator_mxnet_tpu/gluon/block.py",
     "incubator_mxnet_tpu/module/",
+    # the sharded-manifest checkpoint writer (docs/elastic.md): a
+    # torn shard or manifest must be impossible by construction
+    "incubator_mxnet_tpu/parallel/checkpoint.py",
 )
 
 # Input-pipeline modules.  In these, a bare ``queue.get()`` with no
@@ -533,6 +536,54 @@ def check_env_vars(files):
     return sorted(set(problems))
 
 
+# fault-injection entry points: a string literal passed as the
+# SCOPE of resilience.inject()/fault_for() names an injectable fault
+# scope, which must appear (as `scope:`) in the grammar table of
+# docs/resilience.md — an operator writing an MXTPU_FAULT_SPEC must
+# always find the scope's meaning and valid ops there.
+FAULT_SCOPE_FACTORIES = {"inject", "fault_for"}
+
+
+def check_fault_scopes(files):
+    """Every literal fault scope used in code must be documented in
+    docs/resilience.md's injection grammar (ops may be dynamic —
+    e.g. ``elastic:rank<N>`` — so only the scope is checked)."""
+    docs = Path("docs/resilience.md")
+    if not docs.exists():
+        return []
+    grammar = docs.read_text()
+    problems = []
+    for path in files:
+        posix = path.as_posix()
+        if "incubator_mxnet_tpu" not in posix \
+                and "tools" not in posix:
+            continue
+        try:
+            tree = ast.parse(path.read_text())
+        except SyntaxError:
+            continue        # reported by check_file
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            fn = node.func
+            fname = fn.id if isinstance(fn, ast.Name) else \
+                fn.attr if isinstance(fn, ast.Attribute) else ""
+            if fname not in FAULT_SCOPE_FACTORIES:
+                continue
+            arg = node.args[0]
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)):
+                continue
+            scope = arg.value
+            if f"`{scope}:" not in grammar:
+                problems.append(
+                    f"{path}:{node.lineno}: fault scope {scope!r} "
+                    "is not documented in the injection grammar of "
+                    "docs/resilience.md (declare it like "
+                    "`" + scope + ":<op>`)")
+    return sorted(set(problems))
+
+
 def check_metric_catalog(files):
     """Every metric/span name created via the telemetry registry —
     a string literal passed to counter()/gauge()/histogram()/span()
@@ -628,6 +679,7 @@ def main(argv):
         problems.extend(check_file(f))
     problems.extend(check_env_vars(files))
     problems.extend(check_metric_catalog(files))
+    problems.extend(check_fault_scopes(files))
     for p in problems:
         print(p)
     print(f"lint: {len(files)} files, {len(problems)} problems")

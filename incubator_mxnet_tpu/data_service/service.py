@@ -24,6 +24,13 @@ Contracts:
   serialize into ``state_dict()`` (and therefore into the ``.data``
   checkpoint companions); restore respawns every live shard at its
   exact cursor, so a mid-epoch resume lands on the exact next batch.
+  A state saved with W workers restores under W′ ≠ W (elastic
+  restart changed the data-worker count): the merged stream's
+  position is one global batch index, and the per-shard cursors are
+  re-derived round-robin (``io.sharding.reshard_batch_cursors``) —
+  bit-consistent with the uninterrupted stream, except that with
+  quarantined corrupt records the resume replays the W′ stream to
+  the same global batch instead (docs/elastic.md).
 - **Supervision** — a worker observed dead (SIGKILL, OOM) is
   respawned from its last-delivered cursor under the
   ``MXTPU_DATA_WORKER_RESTARTS`` budget with flight-recorder events
@@ -46,6 +53,7 @@ import numpy as np
 
 from .. import telemetry
 from ..io.io import DataBatch, DataDesc, DataIter
+from ..io.sharding import reshard_batch_cursors
 from ..ndarray.ndarray import array as nd_array
 from ..resilience import DataPipelineError, data_timeout, inject
 from ..tracing import trace_event
@@ -189,6 +197,13 @@ class DataServiceIter(DataIter):
             for w in range(self._W):
                 if not self._shard_done[w]:
                     self._spawn_shard(w)
+            # a resharded-under-quarantine position resumes by exact
+            # replay: deliver-and-discard to the recorded global
+            # batch (ImageRecordIter's replay-discard semantics —
+            # corrupt records re-quarantine deterministically)
+            skip = int(st.get("pending_skip", 0))
+            for _ in range(skip):
+                self._consume_one()
             return
         clean = all(self._shard_done)
         if not clean:
@@ -291,21 +306,83 @@ class DataServiceIter(DataIter):
             raise ValueError(
                 f"state_dict type {state.get('type')!r} does not "
                 "match DataServiceIter")
-        if int(state.get("num_shards", -1)) != self._W:
-            raise ValueError(
-                f"state_dict was taken with "
-                f"{state.get('num_shards')} worker shard(s); this "
-                f"service runs {self._W} — per-shard cursors cannot "
-                "be remapped, reconstruct with the same num_workers")
         order = state.get("order") or []
         if sorted(order) != sorted(self._base_keys):
+            # the one genuinely un-reshardable mismatch: cursors
+            # into a different dataset mean nothing here
             raise ValueError(
                 "iterator state's key set does not match this "
                 "dataset's .idx — state from a different dataset?")
+        if int(state.get("num_shards", -1)) != self._W:
+            state = self._reshard_state(state)
         self._halt_workers()
         self._shard_done = [True] * self._W   # nothing in flight
         self._resume_state = dict(state)
         self._resume_pending = True
+
+    def _reshard_state(self, state):
+        """Re-express a position saved with W workers for this
+        service's W′ (elastic restart changed the data-worker count,
+        docs/elastic.md).  The merged stream's position is the next
+        *global* batch — round-robin re-derivation of the per-shard
+        cursors (io.sharding.reshard_batch_cursors) resumes it
+        bit-consistently: worker random draws are keyed to global
+        batch indices, so the remaining stream is identical to an
+        uninterrupted run's.
+
+        Quarantined corrupt records entangle the saved key cursors
+        with the OLD shards' top-up reads, so when any were recorded
+        the resume replays from the epoch start instead
+        (deliver-and-discard to the same global batch — exact, since
+        corruption re-quarantines deterministically; the quarantine
+        ledger re-counts from zero during the replay)."""
+        W_old = int(state.get("num_shards", -1))
+        Wn = self._W
+        order = list(state["order"])
+        n = len(order)
+        B = self.batch_size
+        nb = (n + B - 1) // B
+        # a state saved while a quarantine-replay resume was still
+        # pending holds its position in pending_skip (cursors are
+        # zeroed): carry it forward, and stay in replay mode — the
+        # entanglement reason (corrupt records) has not gone away
+        # even though its bad_total ledger was reset
+        pend = int(state.get("pending_skip", 0))
+        replay = int(state.get("bad_total", 0)) > 0 or pend > 0
+        delivered_total = sum(int(v)
+                              for v in state["shard_delivered"]) \
+            + pend
+        g = delivered_total if replay \
+            else min(int(state["bidx"]), nb)
+        trace_event("data_cursor_reshard", from_shards=W_old,
+                    to_shards=Wn, next_batch=g, replay=replay)
+        new = dict(state)
+        new["num_shards"] = Wn
+        new["shard_bad"] = [0] * Wn
+        new["bad_total"] = 0
+        new.pop("pending_skip", None)
+        if replay:
+            warnings.warn(
+                f"DataServiceIter: resharding {W_old} -> {Wn} "
+                f"worker cursor(s) saved under quarantine: resuming "
+                f"by exact replay of {g} batch(es) from the epoch "
+                "start (corrupt records re-quarantine against a "
+                "fresh MXTPU_MAX_BAD_RECORDS budget)",
+                RuntimeWarning)
+            new.update(bidx=0, shard_consumed=[0] * Wn,
+                       shard_delivered=[0] * Wn,
+                       shard_done=[False] * Wn, pending_skip=g)
+            return new
+        delivered, done = reshard_batch_cursors(nb, g, Wn)
+        # event cursors count attempted keys: with no quarantine on
+        # record, every delivered batch consumed exactly B keys —
+        # the only short batch is the last one (index nb-1), and
+        # g <= nb-1 here (g == nb marks every shard done and no
+        # worker respawns), so it is never part of the count
+        consumed = [d * B for d in delivered]
+        new.update(bidx=g, shard_consumed=consumed,
+                   shard_delivered=delivered, shard_done=done)
+        return new
 
     def skip(self, num_batches):
         """Fast-forward by delivering-and-discarding (exact under

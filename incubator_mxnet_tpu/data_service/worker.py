@@ -142,11 +142,37 @@ def _try_native(pairs, spec, rng, data, label, label_width):
     return True
 
 
+def _die_with_parent():
+    """Arm the Linux parent-death signal: a rank hard-killed by the
+    elastic supervisor (SIGKILL / injected ``elastic:rank`` kill —
+    no teardown, no atexit, so multiprocessing's daemon cleanup
+    never runs) must not orphan its decode workers.  Orphans would
+    survive holding their /dev/shm ring segments and the parent's
+    inherited pipes open — leaking shared memory and wedging any
+    launcher/pytest reader waiting for pipe EOF.  With PDEATHSIG the
+    kernel reaps the whole decode fleet the instant the rank dies.
+    Best-effort: off Linux this is a no-op and close() remains the
+    only cleanup path (docs/elastic.md failure matrix)."""
+    try:
+        import ctypes
+        import signal
+        libc = ctypes.CDLL(None, use_errno=True)
+        PR_SET_PDEATHSIG = 1
+        libc.prctl(PR_SET_PDEATHSIG, signal.SIGKILL, 0, 0, 0)
+    except Exception:
+        return
+    if os.getppid() == 1:
+        # parent already died in the fork->prctl window: the signal
+        # will never arrive, exit now instead of idling forever
+        os._exit(0)
+
+
 def worker_main(ring, conn, static_spec):
     """Child-process entry: prefault the ring pages, then serve one
     epoch per control-pipe command until the pipe closes.  An epoch
     ends with an END slot; any raise ships as an ERROR slot and the
     worker survives to take the next command."""
+    _die_with_parent()
     ring.prefault()
     while True:
         try:

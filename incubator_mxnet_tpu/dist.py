@@ -21,7 +21,8 @@ the reference's `kv.num_workers`-driven behavior carries over.
 """
 import os
 
-__all__ = ["init", "is_initialized", "rank", "num_workers",
+__all__ = ["init", "is_initialized", "shutdown", "rank",
+           "num_workers", "world_generation", "elastic_probe",
            "allreduce_sum", "allreduce_max", "broadcast", "barrier"]
 
 _initialized = False
@@ -101,25 +102,11 @@ def init(coordinator_address=None, num_workers_=None, rank_=None):
         """jax sets global_state.client/.service *before* connect(),
         so a failed join leaves them populated and the next
         initialize raises 'should only be called once' — masking the
-        real transient error and making the retry a no-op.  Clear
-        the globals so each attempt starts clean."""
-        try:
-            from jax._src.distributed import global_state
-        except ImportError:
-            try:
-                jax.distributed.shutdown()
-            except Exception:
-                pass
-            return
-        try:
-            global_state.shutdown()
-        except Exception:
-            pass
-        # a client that never connected can refuse shutdown();
-        # null the slots regardless
-        global_state.client = None
-        global_state.service = None
-        global_state.preemption_sync_manager = None
+        real transient error and making the retry a no-op.
+        :func:`shutdown` owns the one copy of that private-state
+        teardown (it also serves elastic re-init); _initialized is
+        already False here, so the reset is a pure state clear."""
+        shutdown()
 
     def join():
         resilience.inject("dist", "init")
@@ -136,7 +123,79 @@ def init(coordinator_address=None, num_workers_=None, rank_=None):
         join, op_name=f"dist.init(rank={r}, coord={coord})",
         retry_on=(resilience.TransientError,))
     _initialized = True
+    _note_world(r, n)
     return r
+
+
+def _note_world(r, n):
+    """Attribute this boot's world in telemetry/tracing: under the
+    launcher's elastic mode every (re)launch carries a monotonically
+    increasing MXTPU_WORLD_GENERATION, so metrics and flight-recorder
+    events can be pinned to the world they came from — an elastic
+    restart is observable, not inferred from log archaeology."""
+    from . import telemetry, tracing
+    from .utils.env import get_env
+    gen = get_env("MXTPU_WORLD_GENERATION")
+    if gen <= 0:
+        return
+    telemetry.gauge("elastic_world_generation").set(gen)
+    if gen > 1:
+        # generation 1 is the first launch; anything later is an
+        # elastic restart this worker is participating in
+        telemetry.counter("elastic_restarts_total").inc()
+        tracing.trace_event("elastic_world_resize", generation=gen,
+                            world=n, rank=r,
+                            elastic=bool(get_env("MXTPU_ELASTIC")))
+
+
+def shutdown():
+    """Leave the distributed runtime so a *different* world can
+    re-init in this process (coordinated elastic recovery: after a
+    CollectiveAbortedError the broken world's runtime state must be
+    torn down before the new world's coordinator join).  Safe to call
+    when never initialized; after it, :func:`init` works again with
+    fresh env/arguments."""
+    global _initialized
+    import jax
+    try:
+        from jax._src.distributed import global_state
+    except ImportError:
+        try:
+            jax.distributed.shutdown()
+        except Exception:
+            pass
+        _initialized = False
+        return
+    try:
+        global_state.shutdown()
+    except Exception:
+        pass
+    global_state.client = None
+    global_state.service = None
+    global_state.preemption_sync_manager = None
+    _initialized = False
+
+
+def world_generation():
+    """The launcher-exported world generation (0 when this process
+    is not launcher-managed)."""
+    from .utils.env import get_env
+    return get_env("MXTPU_WORLD_GENERATION")
+
+
+def elastic_probe():
+    """Per-step elastic fault hook: scope ``elastic``, op
+    ``rank<N>`` — ``elastic:rank1:3:kill`` hard-kills rank 1 on its
+    3rd step, the deterministic stand-in for an OOM-killed / lost
+    worker (docs/elastic.md).  Free when no fault spec is set (one
+    env read, no rank lookup)."""
+    from . import resilience
+    if not resilience.faults_active():
+        return
+    import jax
+    r = jax.process_index() if _initialized else \
+        int(os.environ.get("MXTPU_WORKER_RANK", "0"))
+    resilience.inject("elastic", "rank%d" % r)
 
 
 def rank():
@@ -196,10 +255,19 @@ def _guarded(op, tag, body):
     timeout = resilience.collective_timeout()
     if not resilience.faults_active() and (timeout <= 0 or not multi):
         return entered_body()
-    return resilience.deadline_call(
-        checked, timeout, op_name=f"collective {op}",
-        detail=f"tag={tag} rank={jax.process_index()} "
-               f"num_workers={jax.process_count()}")
+    try:
+        return resilience.deadline_call(
+            checked, timeout, op_name=f"collective {op}",
+            detail=f"tag={tag} rank={jax.process_index()} "
+                   f"num_workers={jax.process_count()}")
+    except resilience.DeadlineExceededError as exc:
+        # tag the expiry as collective-shaped: THIS rank is healthy,
+        # a peer is dead or wedged — only these deadline errors may
+        # take the elastic exit (14); a local deadline (disk, queue)
+        # means this rank itself is sick and must look like a crash
+        # so the elastic policy shrinks it out (docs/elastic.md)
+        exc.collective = True
+        raise
 
 
 def allreduce_sum(value):

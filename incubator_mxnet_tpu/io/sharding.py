@@ -22,7 +22,8 @@ shard-correctness proof):
   determinism contract of ``DataServiceIter``).
 """
 
-__all__ = ["shard_range", "shard_keys", "assigned_batches"]
+__all__ = ["shard_range", "shard_keys", "assigned_batches",
+           "reshard_batch_cursors"]
 
 
 def shard_range(n, num_parts, part_index):
@@ -59,3 +60,32 @@ def assigned_batches(num_batches, num_shards, shard):
         raise ValueError(
             f"shard {shard} out of range for {num_shards} shard(s)")
     return list(range(shard, num_batches, num_shards))
+
+
+def reshard_batch_cursors(num_batches, next_batch, num_shards):
+    """Re-express a global round-robin stream position under a new
+    shard count (the data-plane half of elastic restart,
+    docs/elastic.md): the merged stream delivers global batches in
+    order, so its exact position is ONE number — ``next_batch``, the
+    next global batch index — and any shard count can re-derive its
+    per-shard cursors from it.
+
+    Returns ``(delivered, done)`` lists of length ``num_shards``:
+    ``delivered[w]`` counts the batches of shard ``w``'s assignment
+    (:func:`assigned_batches`) that lie before ``next_batch``, and
+    ``done[w]`` is True when nothing of the assignment remains.  The
+    union of remaining per-shard assignments is exactly the global
+    range ``[next_batch, num_batches)``, each batch exactly once —
+    the same exactly-once contract as the forward partition.
+    """
+    if num_shards < 1:
+        raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+    if next_batch < 0:
+        raise ValueError(f"next_batch must be >= 0, got {next_batch}")
+    g = min(int(next_batch), int(num_batches))
+    delivered, done = [], []
+    for w in range(num_shards):
+        d = 0 if g <= w else (g - 1 - w) // num_shards + 1
+        delivered.append(d)
+        done.append(w + d * num_shards >= num_batches)
+    return delivered, done

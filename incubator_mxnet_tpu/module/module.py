@@ -508,6 +508,51 @@ class Module(BaseModule):
         return load_data_state(prefix, epoch, data_iter,
                                strict=strict)
 
+    # ----------------------------------------------------- elastic ckpt
+    def save_sharded_checkpoint(self, ckpt_dir, step=None,
+                                data_iter=None):
+        """Elastic sharded checkpoint (docs/elastic.md): params +
+        aux + in-jit optimizer state land as one manifest generation
+        under ``ckpt_dir``, each rank writing only the slices it
+        owns; the input pipeline's position rides in the same
+        generation when ``data_iter`` is given.  kvstore='tpu' mesh
+        path only — the eager paths keep the legacy
+        prefix/epoch format.  Returns the generation directory."""
+        if self._mesh_step is None:
+            raise RuntimeError(
+                "save_sharded_checkpoint needs the kvstore='tpu' "
+                "mesh step (legacy contexts: use save_checkpoint)")
+        if self._mesh_stale:
+            # an eager update / set_params touched the exec dicts
+            # since the last fused step: checkpoint what the user
+            # sees, not the step's pre-update device values
+            self._push_mesh_params()
+        data_state = data_iter.state_dict() \
+            if data_iter is not None else None
+        return self._mesh_step.save_checkpoint(
+            ckpt_dir, step=step, data_state=data_state)
+
+    def load_sharded_checkpoint(self, ckpt_dir, data_iter=None):
+        """Restore the newest valid sharded generation into the mesh
+        step — resharded onto THIS job's mesh, which need not match
+        the saving job's shape or world size — and re-shard the data
+        iterator's cursors from the generation's companion when
+        ``data_iter`` is given.  Returns the companion state (or
+        None)."""
+        if self._mesh_step is None:
+            raise RuntimeError(
+                "load_sharded_checkpoint needs the kvstore='tpu' "
+                "mesh step (legacy contexts: use model."
+                "load_checkpoint)")
+        state = self._mesh_step.load_checkpoint(ckpt_dir)
+        # restored values are now the source of truth: exec dicts
+        # must re-pull them, and no stale push may clobber them
+        self._mesh_dirty = True
+        self._mesh_stale = False
+        if data_iter is not None and state is not None:
+            data_iter.load_state_dict(state)
+        return state
+
     def save_optimizer_states(self, fname):
         from .. import resilience
         assert self.optimizer_initialized

@@ -10,6 +10,8 @@ the reference lacks (tensor, pipeline, sequence/ring).
   optim           — functional optimizers for compiled steps
   sharding        — parameter sharding rules (regex -> PartitionSpec)
   data_parallel   — ShardedTrainStep: one pjit step = fwd+bwd+psum+opt
+  checkpoint      — sharded reshardable checkpoints with a manifest
+                    (elastic shrink/grow restore, docs/elastic.md)
   pipeline        — GPipe-style scan pipeline over 'pp'
   ring_attention  — sequence parallelism over 'sp' (ppermute ring)
   ulysses_attention — sequence parallelism via all-to-all head
@@ -22,6 +24,8 @@ from . import optim
 from .sharding import ShardingRules, tp_rules_for_dense_stacks, constrain
 from .data_parallel import ShardedTrainStep
 from .symbol_step import SymbolTrainStep
+from .checkpoint import (save_sharded, load_sharded, load_latest,
+                         load_data_companion)
 from .pipeline import pipeline_apply, stack_stage_params
 from .ring_attention import ring_attention, ring_attention_local
 from .ulysses import ulysses_attention, ulysses_attention_local
@@ -31,6 +35,8 @@ __all__ = ["AXES", "make_mesh", "current_mesh", "use_mesh",
            "functionalize", "PureBlock", "optim", "ShardingRules",
            "tp_rules_for_dense_stacks", "constrain",
            "ShardedTrainStep", "SymbolTrainStep",
+           "save_sharded", "load_sharded", "load_latest",
+           "load_data_companion",
            "pipeline_apply", "stack_stage_params",
            "ring_attention", "ring_attention_local",
            "ulysses_attention", "ulysses_attention_local"]

@@ -1,0 +1,510 @@
+"""Sharded, reshardable checkpoints with a manifest
+(docs/elastic.md).
+
+The reference's distributed story tolerates a dying worker (ps-lite
+restarts it) but a restart assumes the *same world*: same mesh shape,
+same world size, same data-worker count.  This module is the layer
+that removes that assumption:
+
+- **Sharded save** — each rank writes only the parameter/optimizer
+  slices it canonically owns (one file per owner device, written via
+  ``resilience.atomic_save`` + CRC32 sidecar), so save cost is
+  O(params/world) instead of every rank serializing the full pytree.
+- **Manifest** — rank 0 writes ``manifest.json`` LAST (the commit
+  marker: a generation without a valid manifest does not exist):
+  mesh axes/shape, per-leaf PartitionSpec + global shape/dtype, the
+  slice->file map, the optimizer-state tree structure, step, and the
+  data-iterator companion ref.
+- **Topology-aware reshard on load** — a manifest restores onto a
+  *different* mesh (dp×tp reshaped, world shrunk or grown): each
+  destination shard is assembled by intersecting its bounds with the
+  recorded source slices (parallel/sharding.py slice arithmetic), so
+  a rank reads only the source shard files that overlap what it
+  needs.
+- **Generations + per-shard fallback** — saves land in
+  ``gen-<step>/`` subdirectories; a corrupt shard or manifest fails
+  that generation and the loader falls back to the newest fully
+  valid one (PR 1 corrupt-load semantics, per shard), keeping
+  ``MXTPU_CKPT_KEEP`` generations on disk.
+
+Fault injection: ``checkpoint:shard:<nth>:truncate|corrupt|error``
+damages (or fails) the nth shard-file write, deterministically
+producing the torn states the fallback path defends against
+(docs/resilience.md grammar).
+"""
+import json
+import os
+import pickle
+import shutil
+
+import numpy as np
+
+import jax
+
+from .. import telemetry, tracing
+from .. import resilience
+from ..utils.env import get_env
+from .sharding import intersect_bounds, shard_bounds, spec_to_json
+
+__all__ = ["save_sharded", "load_sharded", "load_latest",
+           "generations", "load_data_companion", "FORMAT"]
+
+FORMAT = "mxtpu-sharded-v1"
+
+_MANIFEST = "manifest.json"
+_DATA_COMPANION = "data.pkl"
+
+
+# ---------------------------------------------------------------------------
+# leaf flattening: stable string keys for arbitrary pytrees
+# ---------------------------------------------------------------------------
+
+
+def _flatten(tree):
+    """(key, leaf) pairs with jax keystr paths — stable across
+    processes and sessions (dict keys are sorted by tree_flatten)."""
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return [(jax.tree_util.keystr(path), leaf)
+            for path, leaf in leaves]
+
+
+def _named_sharding_for(leaf, mesh):
+    """The leaf's NamedSharding when it is laid out over ``mesh``;
+    None otherwise (single-device scalars, numpy arrays, fresh-init
+    leaves) — those are treated as replicated."""
+    sh = getattr(leaf, "sharding", None)
+    if sh is None or not hasattr(sh, "devices_indices_map"):
+        return None
+    if not hasattr(sh, "spec"):        # SingleDeviceSharding etc.
+        return None
+    if getattr(sh, "num_devices", 0) != mesh.devices.size:
+        return None
+    return sh
+
+
+def _leaf_np(leaf):
+    return np.asarray(leaf)
+
+
+def _full_bounds(shape):
+    return tuple((0, int(d)) for d in shape)
+
+
+def _rel_index(bounds, base):
+    """Numpy index of ``bounds`` relative to a block starting at
+    ``base`` lower corners."""
+    return tuple(slice(lo - b0, hi - b0)
+                 for (lo, hi), (b0, _) in zip(bounds, base))
+
+
+# ---------------------------------------------------------------------------
+# save
+# ---------------------------------------------------------------------------
+
+
+def _shard_file(owner_id):
+    return f"shard-{owner_id:05d}.pkl"
+
+
+def save_sharded(ckpt_dir, tree, mesh, step=None, data_state=None,
+                 extra=None, keep=None):
+    """Write one checkpoint generation of ``tree`` under
+    ``ckpt_dir/gen-<step>/``; returns the generation directory.
+
+    ``tree`` is any pytree of arrays (params / aux / optimizer state
+    / counters).  Each leaf's layout is read off its own sharding:
+    leaves on ``mesh`` save one file entry per *unique* slice,
+    written by the slice's canonical owner; everything else saves as
+    one replicated slice.  In a multi-process world every process
+    calls this with the same tree and writes only the shard files
+    whose owner devices it hosts; the process hosting device 0
+    additionally writes the manifest (last — the commit marker).
+
+    ``step`` defaults to one past the newest existing generation.
+    ``data_state`` (a ``state_dict()`` from the input pipeline) is
+    pickled next to the shards and recorded in the manifest so params
+    and data cursors always travel together.  ``keep`` bounds the
+    retained generations (default ``MXTPU_CKPT_KEEP``); pruning only
+    ever runs on fully-committed older generations.
+    """
+    with telemetry.span("checkpoint_save"):
+        return _save_sharded(ckpt_dir, tree, mesh, step, data_state,
+                             extra, keep)
+
+
+def _save_sharded(ckpt_dir, tree, mesh, step, data_state, extra,
+                  keep):
+    ckpt_dir = os.path.abspath(ckpt_dir)
+    os.makedirs(ckpt_dir, exist_ok=True)
+    if step is None:
+        gens = generations(ckpt_dir, require_valid=False)
+        step = (gens[0] + 1) if gens else 0
+    gen_dir = os.path.join(ckpt_dir, f"gen-{int(step):08d}")
+    os.makedirs(gen_dir, exist_ok=True)
+    my_proc = jax.process_index()
+    min_dev = min(d.id for d in mesh.devices.flat)
+
+    # re-saving an existing step (fallback -> retrain -> same step):
+    # UNCOMMIT the old generation first — unlink its manifest before
+    # any shard is replaced, so no crash point can pair the old
+    # manifest with a mix of old and new shard files (each one
+    # individually CRC-valid = a silently frankensteined restore).
+    # A crash mid-rewrite now leaves the generation invisible and
+    # the loader falls back, per the commit contract.
+    if my_proc == 0:
+        for stale in (_MANIFEST,
+                      resilience.checksum_path(_MANIFEST)):
+            try:
+                os.unlink(os.path.join(gen_dir, stale))
+            except FileNotFoundError:
+                pass
+    # peers must not replace shards before the uncommit lands
+    _sync_processes("mxtpu_ckpt_uncommit")
+
+    files = {}          # owner id -> {slice key: np array}
+    leaves = {}
+    for key, leaf in _flatten(tree):
+        sh = _named_sharding_for(leaf, mesh)
+        shape = tuple(int(d) for d in leaf.shape)
+        spec = spec_to_json(sh.spec) if sh is not None \
+            else [None] * len(shape)
+        slices = []
+        if sh is None:
+            bounds = _full_bounds(shape)
+            name = f"{key}#0"
+            slices.append({"lo": [b[0] for b in bounds],
+                           "hi": [b[1] for b in bounds],
+                           "file": _shard_file(min_dev),
+                           "name": name})
+            if _min_dev_proc(mesh) == my_proc:
+                files.setdefault(min_dev, {})[name] = _leaf_np(leaf)
+        else:
+            by_dev = {s.device.id: s for s in leaf.addressable_shards}
+            for i, (bounds, devs) in enumerate(
+                    sorted(shard_bounds(sh, shape).items())):
+                owner = devs[0]
+                name = f"{key}#{i}"
+                slices.append({"lo": [b[0] for b in bounds],
+                               "hi": [b[1] for b in bounds],
+                               "file": _shard_file(owner.id),
+                               "name": name})
+                if owner.process_index != my_proc:
+                    continue
+                files.setdefault(owner.id, {})[name] = \
+                    np.asarray(by_dev[owner.id].data)
+        leaves[key] = {"shape": list(shape),
+                       "dtype": str(np.dtype(leaf.dtype)),
+                       "spec": spec, "slices": slices}
+
+    for owner_id, payload in sorted(files.items()):
+        kind = resilience.inject("checkpoint", "shard")
+        path = os.path.join(gen_dir, _shard_file(owner_id))
+        resilience.atomic_save(
+            path, lambda f, p=payload: pickle.dump(p, f, protocol=4))
+        if kind in ("truncate", "corrupt"):
+            # injected damage lands on the COMMITTED file, after its
+            # sidecar was written from the healthy bytes — the
+            # bit-rot state the CRC check must catch
+            resilience.damage_file(path, kind)
+        telemetry.counter("checkpoint_shard_saved_total").inc()
+
+    data_ref = None
+    if data_state is not None:
+        # one companion per generation, written by the coordinating
+        # process (per-rank input states across a multi-host world
+        # are the multi-host tier's concern — ROADMAP item 5); in
+        # the common layouts the input position is rank-0-owned or
+        # identical across ranks
+        if my_proc == 0:
+            resilience.atomic_save(
+                os.path.join(gen_dir, _DATA_COMPANION),
+                lambda f: pickle.dump(data_state, f, protocol=4))
+        data_ref = _DATA_COMPANION
+
+    # "manifest written LAST" must hold across the whole world, not
+    # just this process: rank 0 may not commit until every peer's
+    # shard files are durably in place, or a kill in the window
+    # leaves a valid-looking manifest referencing missing shards
+    _sync_processes("mxtpu_ckpt_shards")
+    if my_proc == 0:
+        manifest = {
+            "format": FORMAT,
+            "step": int(step),
+            "mesh": {"axes": list(mesh.axis_names),
+                     "shape": [int(mesh.shape[a])
+                               for a in mesh.axis_names]},
+            "world": {"processes": int(jax.process_count()),
+                      "devices": int(mesh.devices.size),
+                      "generation": int(os.environ.get(
+                          "MXTPU_WORLD_GENERATION", "0") or 0)},
+            "leaves": leaves,
+            "data": data_ref,
+            "extra": extra or {},
+        }
+        resilience.atomic_write_bytes(
+            os.path.join(gen_dir, _MANIFEST),
+            json.dumps(manifest, indent=1, sort_keys=True).encode())
+        _prune(ckpt_dir, keep)
+    return gen_dir
+
+
+def _min_dev_proc(mesh):
+    """process_index hosting the mesh's lowest-id device (the
+    canonical writer of replicated / off-mesh leaves)."""
+    return min(mesh.devices.flat,
+               key=lambda d: d.id).process_index
+
+
+def _sync_processes(tag):
+    """Cross-process ordering point for multi-process saves (no-op
+    single-process, which is every CPU/virtual-mesh run).  Runs
+    under the dist collective deadline so a peer that died
+    mid-checkpoint surfaces as the usual typed failure instead of a
+    wedged save."""
+    if jax.process_count() > 1:
+        from .. import dist
+        dist.barrier(tag)
+
+
+def _prune(ckpt_dir, keep):
+    keep = int(keep if keep is not None else get_env("MXTPU_CKPT_KEEP"))
+    if keep <= 0:
+        return
+    valid = generations(ckpt_dir)
+    for step in valid[keep:]:
+        shutil.rmtree(os.path.join(ckpt_dir, f"gen-{step:08d}"),
+                      ignore_errors=True)
+    # uncommitted (manifest-less) generations — a save killed between
+    # its shard writes and the manifest commit — are invisible to the
+    # loader but still hold O(params/world) of shard bytes; sweep any
+    # OLDER than the newest valid generation (never newer: that is
+    # where an in-flight save may be writing right now)
+    if valid:
+        stale = set(generations(ckpt_dir, require_valid=False)) \
+            - set(valid)
+        for step in stale:
+            if step < valid[0]:
+                shutil.rmtree(
+                    os.path.join(ckpt_dir, f"gen-{step:08d}"),
+                    ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# load
+# ---------------------------------------------------------------------------
+
+
+def generations(ckpt_dir, require_valid=True):
+    """Generation steps under ``ckpt_dir``, newest first.  With
+    ``require_valid`` (default) only generations whose manifest
+    exists and passes its CRC sidecar count — a save that died before
+    the manifest rename is invisible, exactly the commit contract."""
+    out = []
+    try:
+        names = os.listdir(ckpt_dir)
+    except OSError:
+        return out
+    for name in names:
+        if not name.startswith("gen-"):
+            continue
+        stem = name[len("gen-"):]
+        if not stem.isdigit():
+            continue
+        if require_valid:
+            man = os.path.join(ckpt_dir, name, _MANIFEST)
+            if not resilience.verify_checkpoint(man):
+                continue
+        out.append(int(stem))
+    return sorted(out, reverse=True)
+
+
+def _read_manifest(gen_dir):
+    raw = resilience.read_validated_bytes(
+        os.path.join(gen_dir, _MANIFEST))
+    manifest = resilience.decode_or_corrupt(
+        os.path.join(gen_dir, _MANIFEST), lambda: json.loads(raw))
+    if manifest.get("format") != FORMAT:
+        raise resilience.CheckpointCorruptError(
+            f"{gen_dir}: unknown sharded-checkpoint format "
+            f"{manifest.get('format')!r} (want {FORMAT})")
+    return manifest
+
+
+class _ShardReader:
+    """Validated, cached access to a generation's shard files —
+    each file is CRC-checked once and unpickled once, and only the
+    files actually referenced by the requested slices are read."""
+
+    def __init__(self, gen_dir):
+        self.gen_dir = gen_dir
+        self._cache = {}
+
+    def slice_array(self, slc):
+        fname = slc["file"]
+        if fname not in self._cache:
+            path = os.path.join(self.gen_dir, fname)
+            raw = resilience.read_validated_bytes(path)
+            self._cache[fname] = resilience.decode_or_corrupt(
+                path, lambda: pickle.loads(raw))
+        payload = self._cache[fname]
+        if slc["name"] not in payload:
+            raise resilience.CheckpointCorruptError(
+                f"{self.gen_dir}/{fname}: missing slice "
+                f"{slc['name']!r} (manifest/shard mismatch)")
+        return payload[slc["name"]]
+
+
+def _dest_sharding(leaf, mesh):
+    """Destination layout for a target leaf: its own NamedSharding
+    when it lives on ``mesh``, replicated-on-``mesh`` otherwise
+    (fresh-init optimizer scalars live on one device; the restored
+    tree must be mesh-consistent)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    sh = _named_sharding_for(leaf, mesh)
+    return sh if sh is not None else NamedSharding(
+        mesh, PartitionSpec())
+
+
+def _assemble_block(entry, reader, bounds, shape, dtype):
+    """Assemble ONE destination slice by intersecting its bounds
+    with the manifest's source slices, copying only the overlapping
+    regions."""
+    block = np.empty([hi - lo for lo, hi in bounds], dtype)
+    covered = 0
+    for slc in entry["slices"]:
+        src_b = tuple(zip(slc["lo"], slc["hi"]))
+        inter = intersect_bounds(src_b, bounds)
+        if inter is None:
+            continue
+        src = reader.slice_array(slc)
+        if not bounds:          # 0-d leaf
+            return np.asarray(src, dtype)
+        block[_rel_index(inter, bounds)] = \
+            src[_rel_index(inter, src_b)]
+        covered += int(np.prod([hi - lo for lo, hi in inter]))
+    want = int(np.prod([hi - lo for lo, hi in bounds])) \
+        if bounds else 1
+    if covered < want:
+        raise resilience.CheckpointCorruptError(
+            f"slice coverage hole restoring a leaf of shape "
+            f"{shape}: {covered}/{want} elements — source and "
+            "destination partitions disagree on the global shape")
+    return block
+
+
+def _assemble_leaf(entry, reader, dest_sh, shape, dtype):
+    """Build one destination leaf.  Host assembly is done once per
+    UNIQUE destination slice (replicated leaves and dp-replicated tp
+    shards would otherwise redo identical multi-GB copies once per
+    device); each device then gets a device_put of its shared
+    block."""
+    from .sharding import bounds_of
+    by_bounds = {}
+    blocks = {}
+    for dev, idx in dest_sh.devices_indices_map(shape).items():
+        if dev.process_index != jax.process_index():
+            continue
+        bounds = bounds_of(idx, shape)
+        if bounds not in by_bounds:
+            by_bounds[bounds] = _assemble_block(
+                entry, reader, bounds, shape, dtype)
+        blocks[dev] = jax.device_put(by_bounds[bounds], dev)
+    return jax.make_array_from_single_device_arrays(
+        shape, dest_sh, [blocks[d] for d in sorted(
+            blocks, key=lambda d: d.id)])
+
+
+def load_sharded(gen_dir, target_tree, mesh):
+    """Restore one generation INTO the layout of ``target_tree``
+    (a pytree of arrays — typically the live step state — whose
+    shardings define the destination): returns (tree, manifest).
+
+    The target's tree structure and per-leaf global shapes/dtypes
+    must match the manifest — a mismatch is a loud error naming the
+    offending keys, not a silent partial restore (restoring ZeRO or
+    Adam state into a differently-structured optimizer would corrupt
+    training invisibly)."""
+    with telemetry.span("checkpoint_load"):
+        manifest = _read_manifest(gen_dir)
+        reader = _ShardReader(gen_dir)
+        flat = _flatten(target_tree)
+        want = {k for k, _ in flat}
+        have = set(manifest["leaves"])
+        if want != have:
+            missing = sorted(want - have)
+            extra = sorted(have - want)
+            raise ValueError(
+                f"sharded checkpoint {gen_dir} does not match the "
+                f"target tree structure: missing={missing[:8]} "
+                f"extra={extra[:8]} (optimizer/state trees must "
+                "be built the same way they were saved)")
+        out = {}
+        for key, leaf in flat:
+            entry = manifest["leaves"][key]
+            shape = tuple(entry["shape"])
+            dtype = np.dtype(leaf.dtype)
+            if shape != tuple(int(d) for d in leaf.shape) \
+                    or entry["dtype"] != str(dtype):
+                raise ValueError(
+                    f"sharded checkpoint {gen_dir} leaf {key}: "
+                    f"saved {entry['shape']}/{entry['dtype']} vs "
+                    f"target {tuple(leaf.shape)}/{dtype} — global "
+                    "shapes/dtypes must match to reshard")
+            dest_sh = _dest_sharding(leaf, mesh)
+            out[key] = _assemble_leaf(entry, reader, dest_sh,
+                                      shape, dtype)
+        treedef = jax.tree_util.tree_structure(target_tree)
+        keys = [k for k, _ in flat]
+        tree = jax.tree_util.tree_unflatten(
+            treedef, [out[k] for k in keys])
+        return tree, manifest
+
+
+def load_latest(ckpt_dir, target_tree, mesh):
+    """Restore the newest fully-valid generation, falling back past
+    corrupt shards/manifests generation by generation (warning +
+    `checkpoint_shard_fallback` trace event each hop).  Returns
+    (tree, manifest, gen_dir); raises CheckpointCorruptError when no
+    generation restores."""
+    import warnings
+    gens = generations(ckpt_dir)
+    if not gens:
+        raise resilience.CheckpointCorruptError(
+            f"no committed checkpoint generation under {ckpt_dir} "
+            "(a save that died before its manifest rename leaves "
+            "nothing visible, by design)")
+    last_exc = None
+    for i, step in enumerate(gens):
+        gen_dir = os.path.join(ckpt_dir, f"gen-{step:08d}")
+        try:
+            tree, manifest = load_sharded(gen_dir, target_tree, mesh)
+            return tree, manifest, gen_dir
+        except (resilience.CheckpointCorruptError, OSError) as exc:
+            last_exc = exc
+            telemetry.counter("checkpoint_shard_corrupt_total").inc()
+            if i + 1 < len(gens):
+                tracing.trace_event(
+                    "checkpoint_shard_fallback", from_gen=step,
+                    to_gen=gens[i + 1], error=str(exc)[:200])
+                warnings.warn(
+                    f"sharded checkpoint generation {step} failed "
+                    f"validation ({exc}); falling back to generation "
+                    f"{gens[i + 1]}", RuntimeWarning)
+    raise resilience.CheckpointCorruptError(
+        f"every checkpoint generation under {ckpt_dir} failed "
+        f"validation (newest error: {last_exc})")
+
+
+def load_data_companion(gen_dir, manifest=None):
+    """The data-iterator ``state_dict`` saved with a generation, or
+    None when the save carried none (validated + typed like every
+    checkpoint read)."""
+    if manifest is None:
+        manifest = _read_manifest(gen_dir)
+    ref = manifest.get("data")
+    if not ref:
+        return None
+    path = os.path.join(gen_dir, ref)
+    raw = resilience.read_validated_bytes(path)
+    return resilience.decode_or_corrupt(
+        path, lambda: pickle.loads(raw))

@@ -262,6 +262,8 @@ class ShardedTrainStep:
     # ---------------------------------------------------------------- run
     def __call__(self, x, y, rng=None):
         """Run one training step on a *global* batch; returns loss."""
+        from ..dist import elastic_probe
+        elastic_probe()     # elastic:rank<N> injection (docs/elastic.md)
         x, y = _raw(x), _raw(y)
         if rng is None:
             from .. import random_state
@@ -340,61 +342,42 @@ class ShardedTrainStep:
                             _copy_tree(self.states))
 
     # ---------------------------------------------------------- checkpoint
-    def save_checkpoint(self, path):
+    def save_checkpoint(self, path, data_state=None):
         """Write params + states + optimizer state to ``path`` (a
-        directory) via orbax — the sharded/async-capable TPU
-        checkpoint format (the reference's save_checkpoint +
-        save_optimizer_states roles in one artifact).  Values are
+        checkpoint directory) in the native sharded-manifest format
+        (parallel/checkpoint.py, docs/elastic.md): each rank writes
+        only the slices it owns, a rank-0 manifest records the
+        layout, and generations accumulate under the directory with
+        corrupt-shard fallback on load.  ``data_state`` (an input
+        iterator's ``state_dict()``) rides in the same generation so
+        params and data cursors always travel together.  Values are
         copied first so the next step's buffer donation cannot race
-        the write."""
-        import os
-
-        import orbax.checkpoint as ocp
-        path = os.path.abspath(path)
-        with ocp.StandardCheckpointer() as ckptr:
-            ckptr.save(path, self._ckpt_tree(), force=True)
+        the write.  Returns the generation directory written."""
+        from . import checkpoint as _ckpt
+        return _ckpt.save_sharded(
+            path, self._ckpt_tree(), self.mesh,
+            step=int(self.step_count), data_state=data_state,
+            extra={"optimizer": foptim.state_structure(
+                self.opt_state)})
 
     def load_checkpoint(self, path):
-        """Restore a save_checkpoint artifact INTO this step's mesh
-        layout: every leaf comes back device_put with the step's own
-        shardings, so resume works on a different mesh shape than the
-        save ran on."""
-        import os
-
-        import orbax.checkpoint as ocp
-        path = os.path.abspath(path)
-        # abstract template: no device copy needed on the load path.
-        # Fresh-init optimizer scalars live on a single device; the
-        # restored tree must be mesh-consistent, so anything not laid
-        # out over this step's mesh restores replicated on it.
-        rep = NamedSharding(self.mesh, P())
-        n_dev = self.mesh.devices.size
-
-        def spec(x):
-            sh = getattr(x, "sharding", None)
-            if getattr(sh, "num_devices", 0) != n_dev:
-                sh = rep
-            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh)
-
+        """Restore the newest valid generation under ``path`` INTO
+        this step's mesh layout: every leaf is reassembled from the
+        source slices that overlap this step's own shards, so resume
+        works on a different mesh shape / world size than the save
+        ran on (shrink and grow included).  Returns the loaded
+        generation's data-iterator companion state (or None)."""
+        from . import checkpoint as _ckpt
         tree = {"params": self.params, "states": self.states,
                 "opt_state": self.opt_state,
                 "step_count": self.step_count}
-        target = jax.tree_util.tree_map(spec, tree)
-        with ocp.StandardCheckpointer() as ckptr:
-            try:
-                restored = ckptr.restore(path, target)
-            except ValueError as e:
-                if "step_count" not in str(e):
-                    raise
-                # checkpoint predates the step counter: restore the
-                # rest and resume the schedule from 0
-                del target["step_count"]
-                restored = ckptr.restore(path, target)
-                restored["step_count"] = jnp.zeros((), jnp.int32)
+        restored, manifest, gen_dir = _ckpt.load_latest(
+            path, tree, self.mesh)
         self.params = restored["params"]
         self.states = restored["states"]
         self.opt_state = restored["opt_state"]
         self.step_count = restored["step_count"]
+        return _ckpt.load_data_companion(gen_dir, manifest)
 
     def _ckpt_tree(self):
         # generic pytree copy (opt_state nests beyond a flat dict)

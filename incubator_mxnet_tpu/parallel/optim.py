@@ -18,7 +18,22 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["FunctionalOptimizer", "sgd", "adam", "create",
-           "warmup_cosine", "warmup_linear"]
+           "warmup_cosine", "warmup_linear", "state_structure"]
+
+
+def state_structure(state):
+    """JSON-able description of an optimizer-state pytree, recorded
+    in the sharded-checkpoint manifest (``extra['optimizer']``,
+    docs/elastic.md) for operators and tooling: a human reading a
+    manifest sees at a glance which optimizer family and layout the
+    generation holds.  Load-path validation does NOT flow through
+    this record — ``load_sharded`` enforces structure via its own
+    key-set and shape/dtype checks."""
+    import jax as _jax
+    leaves = _jax.tree_util.tree_flatten_with_path(state)[0]
+    return {_jax.tree_util.keystr(path):
+            [list(map(int, leaf.shape)), str(leaf.dtype)]
+            for path, leaf in leaves}
 
 
 def _tree_map(f, *trees, **kw):

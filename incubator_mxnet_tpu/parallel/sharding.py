@@ -20,7 +20,9 @@ import re
 from jax.sharding import NamedSharding, PartitionSpec
 
 __all__ = ["ShardingRules", "tp_rules_for_dense_stacks",
-            "apply_rules", "constrain"]
+            "apply_rules", "constrain", "spec_to_json",
+            "spec_from_json", "bounds_of", "shard_bounds",
+            "intersect_bounds"]
 
 P = PartitionSpec
 
@@ -105,3 +107,70 @@ def constrain(x, mesh, *spec):
     import jax
     return jax.lax.with_sharding_constraint(
         x, NamedSharding(mesh, P(*spec)))
+
+
+# ---------------------------------------------------------------------------
+# spec-driven slice arithmetic (the reshardable-checkpoint substrate,
+# parallel/checkpoint.py / docs/elastic.md): a PartitionSpec over a
+# mesh partitions an array into rectangular slices; saving records the
+# slices each rank owns, loading intersects a *destination* slice with
+# the recorded source slices so a restore reads only the shard files
+# that overlap it — on any mesh shape, world size, or spec.
+# ---------------------------------------------------------------------------
+
+
+def spec_to_json(spec):
+    """PartitionSpec -> JSON-able list (None | axis | [axes...] per
+    dim), the manifest's layout record."""
+    out = []
+    for el in tuple(spec):
+        if el is None or isinstance(el, str):
+            out.append(el)
+        else:
+            out.append(list(el))
+    return out
+
+
+def spec_from_json(data):
+    """Inverse of :func:`spec_to_json`."""
+    return PartitionSpec(*[
+        tuple(el) if isinstance(el, list) else el for el in data])
+
+
+def bounds_of(idx, shape):
+    """Normalize a devices_indices_map index (tuple of slices with
+    None defaults) to a bounds tuple ``((lo, hi), ...)``, one
+    closed-open interval per dim — the ONE definition of the
+    index→bounds rule, shared by the save and load sides of the
+    sharded checkpoint (a skew between them would corrupt
+    restores)."""
+    return tuple((0 if s.start is None else int(s.start),
+                  int(dim) if s.stop is None else int(s.stop))
+                 for s, dim in zip(idx, shape))
+
+
+def shard_bounds(sharding, shape):
+    """Partition an array of ``shape`` by ``sharding`` into unique
+    rectangular slices: dict mapping a bounds tuple
+    ``((lo, hi), ...)`` (one closed-open interval per dim) to the
+    mesh devices holding that slice, sorted by device id — the first
+    device is the slice's canonical *owner* (the one rank that writes
+    it, so save cost is O(params/world) under replication)."""
+    shape = tuple(int(d) for d in shape)
+    out = {}
+    for dev, idx in sharding.devices_indices_map(shape).items():
+        out.setdefault(bounds_of(idx, shape), []).append(dev)
+    return {b: sorted(devs, key=lambda d: d.id)
+            for b, devs in out.items()}
+
+
+def intersect_bounds(a, b):
+    """Intersection of two bounds tuples, or None when disjoint
+    (0-d bounds ``()`` intersect to ``()``)."""
+    out = []
+    for (a0, a1), (b0, b1) in zip(a, b):
+        lo, hi = max(a0, b0), min(a1, b1)
+        if lo >= hi:
+            return None
+        out.append((lo, hi))
+    return tuple(out)

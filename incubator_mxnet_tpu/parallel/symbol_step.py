@@ -172,6 +172,8 @@ class SymbolTrainStep:
         inputs: dict name -> array (host or device); returns the list
         of output arrays (replicated loss heads / sharded outputs).
         """
+        from ..dist import elastic_probe
+        elastic_probe()     # elastic:rank<N> injection (docs/elastic.md)
         if rng is None:
             from .. import random_state
             rng = random_state.next_key()
@@ -241,3 +243,36 @@ class SymbolTrainStep:
         self.params = _owned_put_tree(dict(param_vals), rep)
         arep = {n: replicated(self.mesh) for n in aux_vals}
         self.aux = _owned_put_tree(dict(aux_vals), arep)
+
+    # ---------------------------------------------------------- checkpoint
+    def save_checkpoint(self, path, step=None, data_state=None):
+        """Write params + aux + optimizer state as one sharded
+        generation under ``path`` (parallel/checkpoint.py manifest
+        format, docs/elastic.md) — the Module frontend's elastic
+        checkpoint: each rank writes only its owned slices, and the
+        input iterator's ``data_state`` rides in the same generation.
+        Returns the generation directory."""
+        from . import checkpoint as _ckpt
+        tree = {"params": _copy_tree(self.params),
+                "aux": _copy_tree(dict(self.aux)),
+                "opt_state": _copy_tree(self.opt_state)}
+        return _ckpt.save_sharded(
+            path, tree, self.mesh, step=step, data_state=data_state,
+            extra={"optimizer": foptim.state_structure(
+                self.opt_state)})
+
+    def load_checkpoint(self, path):
+        """Restore the newest valid generation INTO this step's mesh
+        layout — reassembled per-shard from the overlapping source
+        slices, so the saving job's mesh shape / world size need not
+        match this one's.  Returns the generation's data-iterator
+        companion state (or None)."""
+        from . import checkpoint as _ckpt
+        tree = {"params": self.params, "aux": dict(self.aux),
+                "opt_state": self.opt_state}
+        restored, manifest, gen_dir = _ckpt.load_latest(
+            path, tree, self.mesh)
+        self.params = restored["params"]
+        self.aux = restored["aux"]
+        self.opt_state = restored["opt_state"]
+        return _ckpt.load_data_companion(gen_dir, manifest)

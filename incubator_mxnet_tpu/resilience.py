@@ -47,12 +47,14 @@ from .utils.env import get_env
 __all__ = ["ResilienceError", "TransientError", "DeadlineExceededError",
            "CollectiveAbortedError", "DataPipelineError",
            "CheckpointCorruptError", "BadStepError", "DivergedError",
+           "ElasticRestartRequested", "ELASTIC_EXIT_CODE",
            "NumericGuard", "install_diverged_exithook",
            "RetryPolicy", "retry_call",
            "deadline_call", "call_transient_mapped", "TRANSIENT_MARKERS",
            "JOIN_TRANSIENT_MARKERS", "decode_or_corrupt",
            "parse_fault_spec", "faults_active",
            "fault_for", "inject", "reset_faults", "atomic_save",
+           "damage_file",
            "atomic_write_bytes", "checksum_path", "verify_checkpoint",
            "validate_or_raise", "read_validated_bytes",
            "start_heartbeat", "stop_heartbeat",
@@ -81,7 +83,40 @@ class CollectiveAbortedError(ResilienceError):
 
     Never retried in place: peers may have completed the op, and a
     rank-local re-entry would pair with their *next* collective.
-    Recovery is the launcher restart loop's job."""
+    Recovery is the launcher restart loop's job — under
+    ``tools/launch.py --elastic`` (MXTPU_ELASTIC=1) an uncaught one
+    terminates the worker with :data:`ELASTIC_EXIT_CODE` so the
+    restart is attributed as a coordinated elastic abort, not a
+    crash.  Constructing one dumps the flight recorder when
+    ``MXTPU_TRACE_DUMP`` is set: the surviving ranks' last events
+    before the abort are the post-mortem an operator wants."""
+
+    EXIT_CODE = 14
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        _flight_dump("collective_aborted")
+
+
+class ElasticRestartRequested(ResilienceError):
+    """A worker deliberately requests a coordinated elastic restart
+    (e.g. re-admission of a replaced rank at a checkpoint boundary).
+    Uncaught, it terminates the process with
+    :data:`ELASTIC_EXIT_CODE` via the exithook — the launcher's
+    --elastic loop relaunches the full target world, resuming from
+    the newest sharded checkpoint generation (docs/elastic.md)."""
+
+    EXIT_CODE = 14
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        _flight_dump("elastic_restart_requested")
+
+
+# tools/launch.py mirrors this by value (it must run without the
+# package importable); distinct from DivergedError.EXIT_CODE (13) so
+# the restart ledger can tell elastic world changes from divergence
+ELASTIC_EXIT_CODE = 14
 
 
 class CheckpointCorruptError(ResilienceError, IOError):
@@ -362,7 +397,7 @@ _FAULT_CACHE = (None, ())          # (raw env string, parsed specs)
 _FAULT_COUNTS = {}                 # (scope, op) -> calls seen
 
 _FAULT_KINDS = ("hang", "error", "truncate", "corrupt",
-                "nan", "inf", "spike")
+                "nan", "inf", "spike", "kill")
 
 # numeric poison kinds: only meaningful where step numerics flow —
 # gradients (scope 'grad', applied by the guarded updaters) and loss
@@ -410,6 +445,14 @@ def parse_fault_spec(raw):
             raise ValueError(
                 f"bad fault spec {entry!r}: kind 'spike' only "
                 "applies to the 'loss' scope")
+        if kind == "kill" and scope != "elastic":
+            # hard process death is the elastic layer's test vector
+            # (rank dies mid-step, docs/elastic.md); accepting it on
+            # scopes with in-process recovery semantics would just
+            # kill the test harness
+            raise ValueError(
+                f"bad fault spec {entry!r}: kind 'kill' only "
+                "applies to the 'elastic' scope")
         if nth != "*":
             try:
                 nth = int(nth)
@@ -467,10 +510,12 @@ def inject(scope, op):
     MXTPU_FAULT_HANG_S (run this *inside* a deadline-wrapped callable
     so the deadline, not the sleep, decides the outcome);
     ``truncate``/``corrupt`` are returned for data-path callers
-    (atomic_save) to apply, as are the numeric kinds
-    ``nan``/``inf``/``spike`` for the step-sentinel callers
-    (guarded updaters poison a gradient, check_loss poisons the
-    loss — docs/numeric_stability.md)."""
+    (atomic_save, sharded checkpoint shard writes) to apply, as are
+    the numeric kinds ``nan``/``inf``/``spike`` for the
+    step-sentinel callers (guarded updaters poison a gradient,
+    check_loss poisons the loss — docs/numeric_stability.md);
+    ``kill`` (scope ``elastic`` only) hard-exits the process
+    mid-step, the elastic restart loop's test vector."""
     kind = fault_for(scope, op)
     if kind == "error":
         raise TransientError(
@@ -478,6 +523,14 @@ def inject(scope, op):
     if kind == "hang":
         time.sleep(get_env("MXTPU_FAULT_HANG_S"))
         return None
+    if kind == "kill":
+        # a rank dying mid-step (OOM kill, host loss): hard exit, no
+        # teardown, no atexit — exactly what the elastic restart
+        # loop must recover from (docs/elastic.md failure matrix)
+        import sys
+        print(f"MXTPU_KILLED injected {scope}:{op} kill "
+              f"(pid {os.getpid()})", file=sys.stderr, flush=True)
+        os._exit(1)
     return kind
 
 
@@ -664,9 +717,22 @@ _DIVERGED_HOOK = {"installed": False}
 
 def install_diverged_exithook():
     """Make an uncaught :class:`DivergedError` terminate the process
-    with ``DivergedError.EXIT_CODE`` instead of the generic 1, so
-    the launcher restart loop (tools/launch.py) can tell divergence
-    — resume from the rolled-back checkpoint — from a crash.
+    with ``DivergedError.EXIT_CODE`` (13) instead of the generic 1,
+    so the launcher restart loop (tools/launch.py) can tell
+    divergence — resume from the rolled-back checkpoint — from a
+    crash.
+
+    Under elastic mode (``MXTPU_ELASTIC=1``, exported by
+    ``tools/launch.py --elastic``) the hook additionally maps an
+    uncaught :class:`CollectiveAbortedError` or a *collective*
+    :class:`DeadlineExceededError` (tagged ``.collective`` by
+    ``dist._guarded`` — a peer died or wedged inside a collective;
+    this rank is healthy but the *world* is broken) — and any
+    :class:`ElasticRestartRequested` — to :data:`ELASTIC_EXIT_CODE`
+    (14), so the launcher restarts the job on the surviving world
+    instead of burning the crash budget.  A non-collective deadline
+    (local disk, queue) crashes normally: that rank is itself sick
+    and the elastic policy must shrink it out, not re-admit it.
 
     Idempotent; chains to the previous excepthook for everything
     else.  dist.init() installs it automatically for launcher-spawned
@@ -679,13 +745,29 @@ def install_diverged_exithook():
 
     def hook(tp, val, tb):
         prev(tp, val, tb)
+        code = None
         if isinstance(val, DivergedError):
+            code = DivergedError.EXIT_CODE
+        elif isinstance(val, ElasticRestartRequested):
+            code = ELASTIC_EXIT_CODE
+        elif isinstance(val, CollectiveAbortedError) \
+                and get_env("MXTPU_ELASTIC"):
+            code = ELASTIC_EXIT_CODE
+        elif isinstance(val, DeadlineExceededError) \
+                and getattr(val, "collective", False) \
+                and get_env("MXTPU_ELASTIC"):
+            # only COLLECTIVE deadline expiries (tagged by
+            # dist._guarded) are "rank healthy, world broken"; a
+            # local deadline means this rank is sick and must crash
+            # normally so the elastic policy shrinks it out
+            code = ELASTIC_EXIT_CODE
+        if code is not None:
             sys.stdout.flush()
             sys.stderr.flush()
             # excepthooks cannot set the interpreter's exit status;
             # traceback is already printed, buffers flushed —
             # hard-exit with the distinct code
-            os._exit(DivergedError.EXIT_CODE)
+            os._exit(code)
 
     sys.excepthook = hook
 
@@ -770,6 +852,23 @@ def _replace_with_bytes(path, data, sync_dir=True):
         _fsync_dir(path)
 
 
+def damage_file(path, kind):
+    """Apply an injected ``truncate``/``corrupt`` to an on-disk file
+    WITHOUT touching its sidecar — the single definition of the
+    fault-injection damage semantics (used by :func:`atomic_save` on
+    the temp file pre-rename and by the sharded-checkpoint writer on
+    a committed shard), so the torn/bit-rot states the validation
+    layer must catch stay identical everywhere.  Unknown kinds are a
+    no-op."""
+    if kind == "truncate":
+        os.truncate(path, max(1, os.path.getsize(path) // 2))
+    elif kind == "corrupt":
+        with open(path, "r+b") as f:
+            first = f.read(1)
+            f.seek(0)
+            f.write(bytes([first[0] ^ 0xFF]) if first else b"\xff")
+
+
 def atomic_save(path, writer):
     """Atomically write a checkpoint: ``writer(fileobj)`` produces
     the payload into a same-directory temp file, which is fsynced and
@@ -795,13 +894,7 @@ def atomic_save(path, writer):
     tmp = _write_tmp(path, writer)
     try:
         crc, size = _file_crc(tmp)
-        if kind == "truncate":
-            os.truncate(tmp, max(1, size // 2))
-        elif kind == "corrupt":
-            with open(tmp, "r+b") as f:
-                first = f.read(1)
-                f.seek(0)
-                f.write(bytes([first[0] ^ 0xFF]) if first else b"\xff")
+        damage_file(tmp, kind)
         try:
             os.unlink(checksum_path(path))
         except FileNotFoundError:

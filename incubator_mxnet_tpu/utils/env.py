@@ -168,6 +168,25 @@ register_env("MXTPU_CKPT_FALLBACK", bool, True,
              "on corrupt/truncated checkpoint load, fall back to the "
              "newest earlier checkpoint that validates")
 
+# Elastic multi-chip training (parallel/checkpoint.py, dist.py,
+# tools/launch.py --elastic; docs/elastic.md).
+register_env("MXTPU_CKPT_KEEP", int, 3,
+             "sharded-checkpoint generations retained per directory "
+             "(parallel.checkpoint.save_sharded prunes older "
+             "fully-committed generations past this); <=0 keeps all")
+register_env("MXTPU_ELASTIC", bool, False,
+             "exported by tools/launch.py --elastic: workers treat "
+             "an uncaught CollectiveAbortedError / collective "
+             "DeadlineExceededError as a coordinated elastic abort "
+             "and exit with the distinct elastic code (14) so the "
+             "launcher restarts on the surviving world instead of "
+             "counting a crash")
+register_env("MXTPU_WORLD_GENERATION", int, 0,
+             "monotonically increasing world generation exported by "
+             "tools/launch.py to every (re)launched worker, so logs "
+             "and telemetry attribute which world a metric came "
+             "from; 0 = not launcher-managed")
+
 # Training-step sentinel (resilience.NumericGuard, optimizer,
 # gluon/trainer, module fit loops; docs/numeric_stability.md).
 register_env("MXTPU_NONFINITE_POLICY", str, "off",

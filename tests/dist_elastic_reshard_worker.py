@@ -1,0 +1,153 @@
+"""Elastic shrink/grow e2e worker (spawned by tests/test_dist_launch
+through ``tools/launch.py --elastic`` — not a pytest module).
+
+Storyline, driven by the launcher's MXTPU_WORLD_GENERATION:
+
+- generation 1 (world 2, 8 virtual devices): rank 0 trains a
+  ShardedTrainStep (dp×tp mesh) fed by a 2-worker DataServiceIter,
+  saving sharded checkpoint generations (data companion included);
+  the ``elastic:rank0:<n>:kill`` fault spec hard-kills it mid-step —
+  the launcher shrinks the world to the survivors.
+- generation 2 (world 1, 4 virtual devices — SHRINK): resumes from
+  the newest manifest generation resharded onto the smaller mesh,
+  with the data cursors resharded 2 -> 1 workers; after a few more
+  steps it checkpoints and raises ElasticRestartRequested (exit 14)
+  to re-admit the replaced worker at this checkpoint boundary.
+- generation 3 (world 2, 8 devices — GROW): resumes on the full
+  mesh and trains to completion.
+
+Ranks > 0 idle and exit 0: multi-process collectives are not
+implemented on this container's CPU backend (see
+tests/test_dist_launch.py baseline), so the elasticity under test is
+the launcher/world/checkpoint/data contract, with the mesh virtual
+per rank — the same code path a TPU pod runs with real devices.
+"""
+import os
+import sys
+
+GEN = int(os.environ.get("MXTPU_WORLD_GENERATION", "1"))
+RANK = int(os.environ.get("MXTPU_WORKER_RANK", "0"))
+
+# mesh size per generation: 8 devices, shrink to 4, grow back to 8
+N_DEV = 4 if GEN == 2 else 8
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = \
+    f"--xla_force_host_platform_device_count={N_DEV}"
+if not (GEN == 1 and RANK == 0):
+    # the injected mid-step kill targets generation 1's rank 0 only;
+    # later generations must run the same code clean
+    os.environ.pop("MXTPU_FAULT_SPEC", None)
+
+import numpy as np  # noqa: E402
+
+TOTAL_STEPS = 12
+GROW_AT_STEP = 8
+BATCH = 8
+SHAPE = (3, 32, 32)
+
+
+def main():
+    if RANK != 0:
+        print(f"RANK_IDLE gen={GEN} rank={RANK}", flush=True)
+        return
+
+    import jax
+    import jax.numpy as jnp
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import resilience
+    from incubator_mxnet_tpu.data_service import DataServiceIter
+    from incubator_mxnet_tpu.parallel import (ShardedTrainStep,
+                                              make_mesh)
+
+    ckdir = os.path.join(os.environ["MXTPU_ELASTIC_DIR"], "ck")
+    rec_prefix = os.environ["MXTPU_ELASTIC_REC"]
+    devs = jax.devices("cpu")[:N_DEV]
+    mesh = make_mesh(dp=N_DEV // 2, tp=2, devices=devs)
+
+    mx.random.seed(0)
+    net = mx.gluon.nn.HybridSequential(prefix="el_")
+    with net.name_scope():
+        net.add(mx.gluon.nn.Dense(16, activation="relu"),
+                mx.gluon.nn.Dense(4))
+    net.initialize(mx.initializer.Xavier())
+    step = ShardedTrainStep(
+        net, optimizer="adam",
+        optimizer_params=dict(learning_rate=1e-3), mesh=mesh,
+        example_args=[jnp.zeros((2, int(np.prod(SHAPE))),
+                                jnp.float32)])
+
+    data_workers = 2 if GEN == 1 else 1
+    it = DataServiceIter(path_imgrec=rec_prefix + ".rec",
+                         data_shape=SHAPE, batch_size=BATCH,
+                         num_workers=data_workers,
+                         preprocess_threads=1)
+    try:
+        run(step, it, ckdir, mesh, data_workers, resilience)
+    finally:
+        it.close()
+
+
+def run(step, it, ckdir, mesh, data_workers, resilience):
+    import numpy as np
+
+    resumed = None
+    try:
+        data_state = step.load_checkpoint(ckdir)
+        resumed = int(step.step_count)
+        if data_state is not None:
+            old_w = data_state.get("num_shards")
+            it.load_state_dict(data_state)
+            print(f"DATA {old_w}->{data_workers}", flush=True)
+    except resilience.CheckpointCorruptError:
+        pass        # generation 1: nothing to resume from
+    print(f"BOOT gen={GEN} world={os.environ['MXTPU_NUM_WORKERS']} "
+          f"devices={mesh.devices.size} "
+          f"resumed={resumed}", flush=True)
+
+    def batches():
+        while True:
+            try:
+                b = it.next()
+            except StopIteration:
+                it.reset()
+                continue
+            x = b.data[0].asnumpy().reshape(BATCH, -1)
+            y = b.label[0].asnumpy().astype(np.int32)
+            yield x, y
+
+    for x, y in batches():
+        loss = float(step(x, y))
+        assert np.isfinite(loss), loss
+        n = int(step.step_count)
+        print(f"STEP {n} gen={GEN} loss={loss:.4f}", flush=True)
+        if n % 2 == 0:
+            step.save_checkpoint(ckdir,
+                                 data_state=it.state_dict())
+        if GEN == 2 and n >= GROW_AT_STEP:
+            # re-admission protocol: checkpoint, then request the
+            # coordinated restart — the launcher grows the world
+            # back to the target at this checkpoint boundary
+            step.save_checkpoint(ckdir,
+                                 data_state=it.state_dict())
+            print("GROW_REQUEST", flush=True)
+            raise resilience.ElasticRestartRequested(
+                "re-admit replaced worker at checkpoint boundary")
+        if n >= TOTAL_STEPS:
+            break
+    print(f"ELASTIC_DONE gen={GEN} steps={int(step.step_count)}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception:
+        # uncaught ElasticRestartRequested must reach the exithook's
+        # exit code even though this main has a try guard
+        from incubator_mxnet_tpu import resilience
+        exc = sys.exc_info()[1]
+        if isinstance(exc, resilience.ElasticRestartRequested):
+            sys.stdout.flush()
+            sys.stderr.flush()
+            os._exit(resilience.ELASTIC_EXIT_CODE)
+        raise

@@ -20,6 +20,7 @@ from incubator_mxnet_tpu import resilience as rz
 from incubator_mxnet_tpu import telemetry, tracing
 from incubator_mxnet_tpu.data_service import DataServiceIter
 from incubator_mxnet_tpu.io.sharding import (assigned_batches,
+                                             reshard_batch_cursors,
                                              shard_keys, shard_range)
 from incubator_mxnet_tpu.resilience import DataPipelineError
 
@@ -252,13 +253,111 @@ def test_service_data_companion_roundtrip(rec48, tmp_path):
         _assert_same(_np_batches(svc2), want, "companion")
 
 
-def test_service_resume_worker_count_mismatch_raises(rec48):
+def test_reshard_batch_cursors_exactly_once():
+    """For every (num_batches, position, shard count): the union of
+    remaining per-shard assignments is exactly [position,
+    num_batches), each batch once — the elastic data-plane
+    contract."""
+    for nb in (0, 1, 5, 6, 7, 12):
+        for W in (1, 2, 3, 5, 8):
+            for g in range(nb + 2):
+                delivered, done = reshard_batch_cursors(nb, g, W)
+                remaining = []
+                for w in range(W):
+                    assigned = assigned_batches(nb, W, w)
+                    assert delivered[w] <= len(assigned)
+                    remaining += assigned[delivered[w]:]
+                    assert done[w] == (delivered[w] >= len(assigned))
+                assert sorted(remaining) == list(range(min(g, nb),
+                                                       nb)), \
+                    (nb, g, W)
+
+
+@pytest.mark.parametrize("W_new", [1, 3, 4])
+def test_service_resume_reshards_worker_count(rec48, W_new):
+    """Elastic data plane (docs/elastic.md): a position saved with
+    W workers resumes bit-consistently under W′ — the remaining
+    stream equals the uninterrupted same-W stream exactly."""
     with _service(rec48, 2) as svc:
-        svc.next()
+        for _ in range(3):
+            svc.next()
+        state = pickle.loads(pickle.dumps(svc.state_dict()))
+        want = _np_batches(svc)
+    with _service(rec48, W_new) as svc2:
+        svc2.load_state_dict(state)
+        svc2.reset()     # fit()'s epoch-start reset must not rewind
+        _assert_same(_np_batches(svc2), want,
+                     f"reshard 2->{W_new}")
+
+
+def test_service_reshard_partial_tail_and_epoch_end(rec44):
+    """Reshard with a padded tail batch, and at exact epoch end."""
+    with _service(rec44, 2) as svc:
+        got = _np_batches(svc)          # full epoch (6 batches)
+        # mid-epoch position past the middle
+        svc.reset()
+        for _ in range(4):
+            svc.next()
         state = svc.state_dict()
-    with _service(rec48, 3) as svc2:
-        with pytest.raises(ValueError, match="per-shard cursors"):
+        end_state = None
+        for _ in range(2):
+            svc.next()
+        end_state = svc.state_dict()    # epoch fully consumed
+    with _service(rec44, 3) as svc2:
+        svc2.load_state_dict(state)
+        _assert_same(_np_batches(svc2), got[4:], "reshard tail")
+    with _service(rec44, 3) as svc3:
+        svc3.load_state_dict(end_state)
+        with pytest.raises(StopIteration):
+            svc3.next()
+
+
+def test_service_reshard_under_quarantine_replays_coherent(
+        rec48, tmp_path, monkeypatch):
+    """With quarantined records the saved cursors are entangled with
+    the OLD shards' top-up reads (each worker tops a short batch up
+    from its own later keys), so batch composition near a corrupt
+    record depends on the worker count and cross-W bit-identity is
+    impossible.  The documented contract (docs/elastic.md): the
+    resharded resume replays and delivers exactly the W′ stream from
+    the same global batch position — identical to a fresh W′ service
+    fast-forwarded by skip(), no batch slot replayed or skipped."""
+    monkeypatch.setenv("MXTPU_MAX_BAD_RECORDS", "16")
+    prefix = _make_jpeg_rec(str(tmp_path / "bad"), 48, bad=(5, 17))
+    with _service(prefix, 2) as svc:
+        for _ in range(2):
+            svc.next()
+        state = pickle.loads(pickle.dumps(svc.state_dict()))
+        assert state["bad_total"] > 0    # quarantine actually fired
+    with _service(prefix, 3) as ref:
+        ref.skip(2)
+        want = _np_batches(ref)
+    with _service(prefix, 3) as svc2:
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
             svc2.load_state_dict(state)
+            svc2.reset()
+            got = _np_batches(svc2)
+        _assert_same(got, want, "reshard replay")
+        assert any("exact replay" in str(w.message) for w in caught)
+
+    # double resize BEFORE the iterator is driven: a state saved
+    # while the replay resume is still pending must carry its
+    # position (pending_skip) into the next reshard, not restart
+    # the epoch
+    with _service(prefix, 3) as mid:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            mid.load_state_dict(state)
+            pending = pickle.loads(pickle.dumps(mid.state_dict()))
+    with _service(prefix, 4) as ref4:
+        ref4.skip(2)
+        want4 = _np_batches(ref4)
+    with _service(prefix, 4) as svc4:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            svc4.load_state_dict(pending)
+        _assert_same(_np_batches(svc4), want4, "double reshard")
 
 
 def test_service_resume_wrong_dataset_raises(rec48, rec44):

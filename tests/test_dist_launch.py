@@ -105,6 +105,153 @@ def test_elastic_resume_from_checkpoint(tmp_path):
     assert "ELASTIC_OK rank 1 attempt 1" in out, out[-3000:]
 
 
+def test_launch_elastic_shrink_grow_policy(tmp_path):
+    """--elastic restart ledger: a crash shrinks the next world to
+    the survivors, an elastic exit (14) re-admits replaced workers
+    back to the target world, each with its own counter/log line and
+    a fresh MXTPU_WORLD_GENERATION (no jax involved)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = (
+        "import os, sys\n"
+        "gen = os.environ['MXTPU_WORLD_GENERATION']\n"
+        "n = os.environ['MXTPU_NUM_WORKERS']\n"
+        "r = os.environ['MXTPU_WORKER_RANK']\n"
+        "el = os.environ.get('MXTPU_ELASTIC')\n"
+        "os.write(1, f'GEN {gen} WORLD {n} RANK {r} "
+        "ELASTIC {el}\\n'.encode())\n"
+        "if gen == '1' and r == '1':\n"
+        "    sys.exit(5)\n"          # crash -> shrink 2 -> 1
+        "if gen == '2':\n"
+        "    sys.exit(14)\n")        # coordinated -> grow back to 2
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "launch.py"),
+         "-n", "2", "--elastic", "--max-elastic-restarts", "3",
+         "--", sys.executable, "-c", script],
+        capture_output=True, text=True, timeout=120, cwd=repo)
+    out = r.stdout + r.stderr
+    assert r.returncode == 0, out[-2000:]
+    assert "ELASTIC restart 1/3: world 2 -> 1 (shrink: rank(s) [1]" \
+        in out, out[-2000:]
+    assert "ELASTIC restart 2/3: world 1 -> 2 (grow" in out, \
+        out[-2000:]
+    assert "GEN 2 WORLD 1 RANK 0 ELASTIC 1" in out, out[-2000:]
+    assert "GEN 3 WORLD 2 RANK 1 ELASTIC 1" in out, out[-2000:]
+
+
+def test_launch_elastic_budget_and_divergence_split(tmp_path):
+    """Divergence (exit 13) keeps consuming --max-restarts even
+    under --elastic; the elastic budget refuses past its own cap."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # divergence: --max-restarts 0 -> no restart, rc 13, no ELASTIC
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "launch.py"),
+         "-n", "1", "--elastic", "--", sys.executable, "-c",
+         "import sys; sys.exit(13)"],
+        capture_output=True, text=True, timeout=60, cwd=repo)
+    assert r.returncode == 13
+    assert "ELASTIC restart" not in r.stdout + r.stderr
+    # crash loop: budget 1 -> exactly one elastic restart, then out
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "launch.py"),
+         "-n", "2", "--elastic", "--max-elastic-restarts", "1",
+         "--", sys.executable, "-c", "import sys; sys.exit(3)"],
+        capture_output=True, text=True, timeout=60, cwd=repo)
+    out = r.stdout + r.stderr
+    assert r.returncode == 3
+    assert "ELASTIC restart 1/1" in out, out[-1500:]
+    assert "elastic restart budget spent" in out, out[-1500:]
+
+
+def test_launch_elastic_ssh_excludes_failed_host(tmp_path):
+    """ssh-mode shrink must drop the failed rank's HOST from the
+    next assignment (its machine may be gone) and re-derive the
+    coordinator from the live pool — not respawn onto the dead box
+    with a pinned coordinator."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    shim = _write_shim(tmp_path)
+    hostfile = tmp_path / "hosts"
+    hostfile.write_text("hostA 1\nhostB 1\n")
+    log = tmp_path / "shim.log"
+    script = (
+        "import os, sys\n"
+        "if os.environ['MXTPU_WORLD_GENERATION'] == '1' "
+        "and os.environ['MXTPU_WORKER_RANK'] == '1':\n"
+        "    sys.exit(5)\n")
+    env = dict(os.environ)
+    env["SSH_SHIM_LOG"] = str(log)
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "launch.py"),
+         "-n", "2", "--launcher", "ssh", "-H", str(hostfile),
+         "--ssh-cmd", shim, "--elastic", "--max-elastic-restarts",
+         "2", "--", sys.executable, "-c", script],
+        capture_output=True, text=True, timeout=120, env=env,
+        cwd=repo)
+    out = r.stdout + r.stderr
+    assert r.returncode == 0, out[-2000:]
+    assert "excluding failed host(s) ['hostB']" in out, out[-2000:]
+    assert "ELASTIC restart 1/2: world 2 -> 1 (shrink" in out, \
+        out[-2000:]
+    calls = [ln for ln in log.read_text().splitlines()
+             if ln.startswith("SHIM ")]
+    # attempt 1: both hosts; attempt 2: only hostA (world 1)
+    assert len(calls) == 3, calls
+    assert calls[2].startswith("SHIM hostA "), calls[2]
+    assert "MXTPU_COORD_ADDR=hostA:" in calls[2], calls[2]
+
+
+def test_elastic_shrink_grow_reshard_e2e(tmp_path):
+    """The full elastic claim (docs/elastic.md): elastic:rank0 kill
+    mid-step -> launch.py --elastic shrinks the world, the survivor
+    resumes from the newest sharded manifest generation RESHARDED
+    onto a smaller mesh with the data cursors resharded 2 -> 1
+    workers, requests re-admission at a checkpoint boundary (exit
+    14), and the grown world finishes the run — zero orphan tmp
+    files in the checkpoint directory."""
+    from test_data_service import _make_jpeg_rec
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rec = _make_jpeg_rec(str(tmp_path / "ds"), 48, edge=32)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "launch.py"),
+         "-n", "2", "--elastic", "--max-elastic-restarts", "3",
+         "--env", "MXTPU_FAULT_SPEC=elastic:rank0:5:kill",
+         "--env", f"MXTPU_ELASTIC_DIR={tmp_path}",
+         "--env", f"MXTPU_ELASTIC_REC={rec}",
+         "--", sys.executable,
+         os.path.join(repo, "tests", "dist_elastic_reshard_worker.py")],
+        capture_output=True, text=True, timeout=600, env=env,
+        cwd=repo)
+    out = r.stdout + r.stderr
+    assert r.returncode == 0, out[-4000:]
+    # gen 1: fresh start on the 8-device mesh, killed mid-step 5
+    assert "BOOT gen=1 world=2 devices=8 resumed=None" in out, \
+        out[-4000:]
+    assert "MXTPU_KILLED injected elastic:rank0 kill" in out, \
+        out[-4000:]
+    assert "ELASTIC restart 1/3: world 2 -> 1 (shrink: rank(s) [0]" \
+        in out, out[-4000:]
+    # gen 2: shrunk world resumes the manifest on 4 devices, data
+    # cursors resharded 2 -> 1, then requests re-admission
+    assert "BOOT gen=2 world=1 devices=4 resumed=4" in out, \
+        out[-4000:]
+    assert "DATA 2->1" in out, out[-4000:]
+    assert "GROW_REQUEST" in out, out[-4000:]
+    assert "ELASTIC restart 2/3: world 1 -> 2 (grow" in out, \
+        out[-4000:]
+    # gen 3: grown world resumes at the grow checkpoint and finishes
+    assert "BOOT gen=3 world=2 devices=8 resumed=8" in out, \
+        out[-4000:]
+    assert "DATA 1->1" in out, out[-4000:]
+    assert "ELASTIC_DONE gen=3 steps=12" in out, out[-4000:]
+    # zero half-written tmp files anywhere near the checkpoints
+    orphans = [f for _, _, fs in os.walk(tmp_path) for f in fs
+               if ".tmp." in f]
+    assert orphans == [], orphans
+
+
 SSH_SHIM = """#!/bin/sh
 # Faithful stand-in for ssh in an image without an ssh client: accepts
 # `shim [-o opt]... host 'remote command'` and runs the command through

@@ -1,0 +1,543 @@
+"""Sharded reshardable checkpoints (parallel/checkpoint.py,
+docs/elastic.md): property test over every mesh factorization of
+1/2/4/8 devices (bitwise round-trip of a randomized pytree including
+nested optimizer state), generation fallback past corrupt shards,
+zero half-written manifests under fault injection, train-step
+integration, and the elastic restart plumbing."""
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import resilience as rz
+from incubator_mxnet_tpu import telemetry
+from incubator_mxnet_tpu.parallel import (make_mesh, checkpoint as ck,
+                                          ShardedTrainStep)
+from incubator_mxnet_tpu.parallel.sharding import (
+    intersect_bounds, shard_bounds, spec_from_json, spec_to_json)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv("MXTPU_FAULT_SPEC", raising=False)
+    rz.reset_faults()
+    yield
+    rz.reset_faults()
+
+
+def _mesh_configs():
+    """Every (n_devices, dp, tp) factorization of 1/2/4/8 devices."""
+    out = []
+    for n in (1, 2, 4, 8):
+        for tp in (1, 2, 4, 8):
+            if n % tp == 0:
+                out.append((n, n // tp, tp))
+    return out
+
+
+def _mesh(n, dp, tp):
+    return make_mesh(dp=dp, tp=tp, devices=jax.devices("cpu")[:n])
+
+
+def _rand_tree(mesh, seed=0):
+    """Randomized params + nested optimizer-state pytree with specs
+    drawn per leaf from what divides its dims on this mesh."""
+    rs = np.random.RandomState(seed)
+    dp, tp = mesh.shape["dp"], mesh.shape["tp"]
+
+    def spec_for(shape):
+        cands = [P()]
+        if shape and shape[0] % tp == 0:
+            cands.append(P("tp"))
+        if shape and shape[0] % dp == 0:
+            cands.append(P("dp"))
+        if len(shape) > 1 and shape[1] % tp == 0:
+            cands.append(P(None, "tp"))
+        if len(shape) > 1 and shape[0] % dp == 0 \
+                and shape[1] % tp == 0:
+            cands.append(P("dp", "tp"))
+        return cands[rs.randint(len(cands))]
+
+    shapes = {"w_up": (16, 8), "w_down": (8, 16), "bias": (16,),
+              "conv": (8, 8, 3), "odd": (7, 5)}
+    params = {}
+    for name, shape in shapes.items():
+        arr = rs.rand(*shape).astype(np.float32)
+        params[name] = jax.device_put(
+            jnp.asarray(arr), NamedSharding(mesh, spec_for(shape)))
+    opt = {"mean": {n: jax.device_put(
+               jnp.asarray(rs.rand(*v.shape).astype(np.float32)),
+               v.sharding) for n, v in params.items()},
+           "t": jnp.asarray(rs.randint(100), jnp.int32)}
+    return {"params": params, "opt_state": opt}
+
+
+def _assert_tree_bitwise(got, want):
+    gl = jax.tree_util.tree_flatten_with_path(got)[0]
+    wl = dict(jax.tree_util.tree_flatten_with_path(want)[0])
+    for path, leaf in gl:
+        ref = wl[path]
+        assert np.array_equal(np.asarray(leaf), np.asarray(ref)), \
+            jax.tree_util.keystr(path)
+
+
+# -------------------------------------------------------- slice math
+def test_spec_json_roundtrip():
+    for spec in (P(), P("tp"), P(None, "tp"), P(("dp", "tp"), None),
+                 P("dp", None, "tp")):
+        assert spec_from_json(spec_to_json(spec)) == spec
+
+
+def test_shard_bounds_partition_exact():
+    mesh = _mesh(8, 4, 2)
+    sh = NamedSharding(mesh, P("dp", "tp"))
+    bounds = shard_bounds(sh, (8, 4))
+    assert len(bounds) == 8                       # 4x2 unique slices
+    total = sum((hi0 - lo0) * (hi1 - lo1)
+                for (lo0, hi0), (lo1, hi1) in bounds)
+    assert total == 32                            # exact cover
+    # replication: a 'tp'-only spec on the same mesh replicates over
+    # dp -> each unique slice held by 4 devices, owner = min id
+    sh2 = NamedSharding(mesh, P(None, "tp"))
+    b2 = shard_bounds(sh2, (8, 4))
+    assert len(b2) == 2
+    for devs in b2.values():
+        assert len(devs) == 4
+        assert devs[0].id == min(d.id for d in devs)
+
+
+def test_intersect_bounds():
+    assert intersect_bounds(((0, 4),), ((2, 8),)) == ((2, 4),)
+    assert intersect_bounds(((0, 4),), ((4, 8),)) is None
+    assert intersect_bounds((), ()) == ()
+
+
+# ---------------------------------------------------- property test
+@pytest.mark.parametrize("src", _mesh_configs())
+def test_reshard_roundtrip_bitwise_all_factorizations(src, tmp_path):
+    """Save on mesh A, restore onto every mesh B (worlds 1/2/4/8,
+    every dp×tp split, random destination specs): params AND nested
+    optimizer state come back bitwise identical, laid out in B's
+    shardings."""
+    n, dp, tp = src
+    meshA = _mesh(n, dp, tp)
+    tree = _rand_tree(meshA, seed=n * 100 + dp)
+    ck.save_sharded(str(tmp_path / "ck"), tree, meshA, step=1)
+    for (n2, dp2, tp2) in _mesh_configs():
+        meshB = _mesh(n2, dp2, tp2)
+        target = _rand_tree(meshB, seed=n2 * 7 + tp2)   # other layout
+        restored, manifest, _ = ck.load_latest(
+            str(tmp_path / "ck"), target, meshB)
+        _assert_tree_bitwise(restored, tree)
+        # values landed in the DESTINATION's shardings
+        for name, leaf in restored["params"].items():
+            assert leaf.sharding == target["params"][name].sharding
+
+
+def test_save_cost_is_sharded_not_replicated(tmp_path):
+    """Each rank file holds only the slices its device owns: for a
+    dp-sharded leaf the per-file payload is 1/dp of the array, and a
+    replicated leaf appears in exactly ONE file (the canonical
+    owner's), not every rank's."""
+    mesh = _mesh(8, 8, 1)
+    big = jax.device_put(jnp.arange(64, dtype=jnp.float32),
+                         NamedSharding(mesh, P("dp")))
+    rep = jax.device_put(jnp.arange(16, dtype=jnp.float32),
+                         NamedSharding(mesh, P()))
+    gen = ck.save_sharded(str(tmp_path / "ck"),
+                          {"big": big, "rep": rep}, mesh, step=0)
+    shard_files = sorted(f for f in os.listdir(gen)
+                         if f.startswith("shard-")
+                         and not f.endswith(".crc32"))
+    assert len(shard_files) == 8
+    holders = []
+    for f in shard_files:
+        with open(os.path.join(gen, f), "rb") as fh:
+            payload = pickle.loads(fh.read())
+        for key, arr in payload.items():
+            if "big" in key:
+                assert arr.size == 8          # 64 / dp=8
+            if "rep" in key:
+                holders.append(f)
+    assert len(holders) == 1                  # one owner, not 8
+
+
+# ------------------------------------------------- generations/chaos
+def test_generation_fallback_past_corrupt_shard(tmp_path,
+                                                monkeypatch):
+    """checkpoint:shard injection corrupts one shard file of the
+    newest generation; load falls back to the newest fully-valid one
+    with telemetry + a trace event, and no tmp files survive."""
+    mesh = _mesh(4, 4, 1)
+    tree = _rand_tree(mesh, seed=3)
+    d = str(tmp_path / "ck")
+    ck.save_sharded(d, tree, mesh, step=1)
+    tree2 = _rand_tree(mesh, seed=4)
+    monkeypatch.setenv("MXTPU_FAULT_SPEC",
+                       "checkpoint:shard:2:corrupt")
+    rz.reset_faults()
+    before = telemetry.counter(
+        "checkpoint_shard_corrupt_total").value
+    ck.save_sharded(d, tree2, mesh, step=2)
+    monkeypatch.delenv("MXTPU_FAULT_SPEC")
+    rz.reset_faults()
+    with pytest.warns(RuntimeWarning, match="falling back"):
+        restored, manifest, _ = ck.load_latest(d, tree, mesh)
+    assert manifest["step"] == 1
+    _assert_tree_bitwise(restored, tree)
+    assert telemetry.counter(
+        "checkpoint_shard_corrupt_total").value > before
+    orphans = [f for _, _, fs in os.walk(d) for f in fs
+               if ".tmp." in f]
+    assert orphans == []
+
+
+def test_truncated_shard_detected(tmp_path, monkeypatch):
+    mesh = _mesh(2, 2, 1)
+    tree = _rand_tree(mesh, seed=5)
+    d = str(tmp_path / "ck")
+    monkeypatch.setenv("MXTPU_FAULT_SPEC",
+                       "checkpoint:shard:1:truncate")
+    rz.reset_faults()
+    ck.save_sharded(d, tree, mesh, step=0)
+    monkeypatch.delenv("MXTPU_FAULT_SPEC")
+    rz.reset_faults()
+    with pytest.raises(rz.CheckpointCorruptError):
+        ck.load_latest(d, tree, mesh)
+
+
+def test_no_halfwritten_manifest_when_save_dies(tmp_path,
+                                                monkeypatch):
+    """A save killed mid-shard-write (injected error) leaves NO
+    manifest — the generation is invisible, the previous one stays
+    newest, and no tmp files leak (the zero-orphan contract)."""
+    mesh = _mesh(4, 2, 2)
+    tree = _rand_tree(mesh, seed=6)
+    d = str(tmp_path / "ck")
+    ck.save_sharded(d, tree, mesh, step=1)
+    monkeypatch.setenv("MXTPU_FAULT_SPEC", "checkpoint:shard:3:error")
+    rz.reset_faults()
+    with pytest.raises(rz.TransientError):
+        ck.save_sharded(d, _rand_tree(mesh, seed=7), mesh, step=2)
+    monkeypatch.delenv("MXTPU_FAULT_SPEC")
+    rz.reset_faults()
+    assert ck.generations(d) == [1]
+    assert not os.path.exists(
+        os.path.join(d, "gen-00000002", "manifest.json"))
+    orphans = [f for _, _, fs in os.walk(d) for f in fs
+               if ".tmp." in f]
+    assert orphans == []
+    restored, manifest, _ = ck.load_latest(d, tree, mesh)
+    assert manifest["step"] == 1
+    # the next committed save sweeps the manifest-less husk: its
+    # shard bytes must not leak under MXTPU_CKPT_KEEP forever
+    ck.save_sharded(d, _rand_tree(mesh, seed=8), mesh, step=3)
+    assert not os.path.isdir(os.path.join(d, "gen-00000002"))
+    assert ck.generations(d) == [3, 1]
+
+
+def test_same_step_resave_recommits_cleanly(tmp_path, monkeypatch):
+    """Re-saving an existing generation (fallback -> retrain -> same
+    step) must uncommit it first: a completed re-save restores the
+    NEW tree, and a re-save that dies mid-shard leaves the
+    generation invisible instead of pairing the old manifest with
+    mixed shard files."""
+    mesh = _mesh(4, 2, 2)
+    d = str(tmp_path / "ck")
+    t1 = _rand_tree(mesh, seed=20)
+    t2 = _rand_tree(mesh, seed=21)
+    ck.save_sharded(d, t1, mesh, step=5)
+    ck.save_sharded(d, t2, mesh, step=5)       # clean re-save
+    restored, manifest, _ = ck.load_latest(d, t1, mesh)
+    assert manifest["step"] == 5
+    _assert_tree_bitwise(restored, t2)
+    # dying mid-re-save uncommits: nothing valid remains at step 5
+    monkeypatch.setenv("MXTPU_FAULT_SPEC", "checkpoint:shard:2:error")
+    rz.reset_faults()
+    with pytest.raises(rz.TransientError):
+        ck.save_sharded(d, _rand_tree(mesh, seed=22), mesh, step=5)
+    monkeypatch.delenv("MXTPU_FAULT_SPEC")
+    rz.reset_faults()
+    assert ck.generations(d) == []
+
+
+def test_generation_pruning_keeps_newest(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXTPU_CKPT_KEEP", "2")
+    mesh = _mesh(2, 2, 1)
+    d = str(tmp_path / "ck")
+    for step in range(4):
+        ck.save_sharded(d, _rand_tree(mesh, seed=step), mesh,
+                        step=step)
+    assert ck.generations(d) == [3, 2]
+
+
+def test_structure_mismatch_is_loud(tmp_path):
+    """Restoring into a differently-built optimizer tree must fail
+    naming the difference, never restore partially."""
+    mesh = _mesh(2, 2, 1)
+    tree = _rand_tree(mesh, seed=8)
+    d = str(tmp_path / "ck")
+    ck.save_sharded(d, tree, mesh, step=0)
+    target = dict(tree)
+    target["opt_state"] = {"var": tree["opt_state"]["mean"],
+                           "t": tree["opt_state"]["t"]}
+    with pytest.raises(ValueError, match="structure"):
+        ck.load_latest(d, target, mesh)
+    bad_shape = {"params": dict(tree["params"]),
+                 "opt_state": tree["opt_state"]}
+    bad_shape["params"]["w_up"] = jnp.zeros((4, 4), jnp.float32)
+    with pytest.raises(ValueError, match="shapes/dtypes"):
+        ck.load_latest(d, bad_shape, mesh)
+
+
+def test_data_companion_travels_with_generation(tmp_path):
+    mesh = _mesh(2, 2, 1)
+    tree = _rand_tree(mesh, seed=9)
+    d = str(tmp_path / "ck")
+    state = {"type": "DataServiceIter", "bidx": 7}
+    ck.save_sharded(d, tree, mesh, step=0, data_state=state)
+    restored, manifest, gen_dir = ck.load_latest(d, tree, mesh)
+    assert ck.load_data_companion(gen_dir, manifest) == state
+
+
+# ------------------------------------------------- step integration
+def _sharded_step(mesh):
+    mx.random.seed(0)
+    net = mx.gluon.nn.HybridSequential(prefix="esck_")
+    with net.name_scope():
+        net.add(mx.gluon.nn.Dense(16, activation="relu"),
+                mx.gluon.nn.Dense(4))
+    net.initialize(mx.initializer.Xavier())
+    return ShardedTrainStep(
+        net, optimizer="adam",
+        optimizer_params=dict(learning_rate=1e-2), mesh=mesh,
+        example_args=[jnp.zeros((2, 8), jnp.float32)])
+
+
+def test_step_checkpoint_shrink_grow_bitwise(tmp_path):
+    """Train on the 8-device mesh, checkpoint, restore onto a
+    4-device world (shrink) and back onto 8 (grow): params, states
+    and optimizer state bitwise equal through both hops."""
+    rs = np.random.RandomState(0)
+    batches = [(jnp.asarray(rs.rand(16, 8), jnp.float32),
+                jnp.asarray(rs.randint(0, 4, (16,)), jnp.int32))
+               for _ in range(4)]
+    a = _sharded_step(make_mesh(dp=8))
+    for x, y in batches:
+        a(x, y)
+    want = {"params": {k: np.asarray(v)
+                       for k, v in a.params.items()},
+            "opt": jax.tree_util.tree_map(np.asarray, a.opt_state)}
+    a.save_checkpoint(str(tmp_path / "ck"))
+
+    b = _sharded_step(make_mesh(dp=4, devices=jax.devices("cpu")[:4]))
+    b.load_checkpoint(str(tmp_path / "ck"))
+    for k, v in b.params.items():
+        assert np.array_equal(np.asarray(v), want["params"][k]), k
+        assert v.sharding.mesh.shape["dp"] == 4
+    _assert_tree_bitwise(b.opt_state, want["opt"])
+    b.save_checkpoint(str(tmp_path / "ck2"))
+
+    c = _sharded_step(make_mesh(dp=8))
+    c.load_checkpoint(str(tmp_path / "ck2"))
+    for k, v in c.params.items():
+        assert np.array_equal(np.asarray(v), want["params"][k]), k
+    _assert_tree_bitwise(c.opt_state, want["opt"])
+    assert int(c.step_count) == 4
+
+
+def test_module_sharded_checkpoint_reshard(tmp_path):
+    """Module (kvstore='tpu') elastic checkpoint: save on dp=8,
+    restore on dp=4 — params visible through get_params, data
+    companion round-trips."""
+    from incubator_mxnet_tpu.parallel import use_mesh
+
+    def build(mesh):
+        data = mx.sym.Variable("data")
+        net = mx.sym.FullyConnected(data, name="esm_fc",
+                                    num_hidden=4)
+        net = mx.sym.SoftmaxOutput(net, name="softmax")
+        mod = mx.mod.Module(net, context=mx.cpu())
+        mod.bind(data_shapes=[("data", (8, 6))],
+                 label_shapes=[("softmax_label", (8,))])
+        mx.random.seed(7)
+        mod.init_params(mx.initializer.Xavier())
+        with use_mesh(mesh):
+            mod.init_optimizer(kvstore="tpu", optimizer="sgd",
+                               optimizer_params=dict(
+                                   learning_rate=0.1, momentum=0.9))
+        return mod
+
+    rs = np.random.RandomState(1)
+    x = mx.nd.array(rs.rand(8, 6).astype(np.float32))
+    y = mx.nd.array(rs.randint(0, 4, (8,)).astype(np.float32))
+    batch = mx.io.DataBatch([x], [y])
+    mod = build(make_mesh(dp=8))
+    for _ in range(3):
+        mod.forward_backward(batch)
+        mod.update()
+    arg, aux = mod.get_params()
+    mod.save_sharded_checkpoint(
+        str(tmp_path / "ck"), step=3,
+        data_iter=_FakeIter({"pos": 42}))
+
+    mod2 = build(make_mesh(dp=4, devices=jax.devices("cpu")[:4]))
+    it = _FakeIter(None)
+    state = mod2.load_sharded_checkpoint(str(tmp_path / "ck"),
+                                         data_iter=it)
+    assert state == {"pos": 42} and it.loaded == {"pos": 42}
+    arg2, aux2 = mod2.get_params()
+    for k in arg:
+        assert np.array_equal(arg[k].asnumpy(), arg2[k].asnumpy()), k
+
+    # eager-touched params (set_params marks the mesh step stale)
+    # must be pushed before checkpointing — not silently dropped
+    new_w = {k: mx.nd.array(np.full(v.shape, 0.5, np.float32))
+             for k, v in arg.items()}
+    mod2.set_params(new_w, aux2)
+    mod2.save_sharded_checkpoint(str(tmp_path / "ck2"), step=4)
+    mod3 = build(make_mesh(dp=4, devices=jax.devices("cpu")[:4]))
+    mod3.load_sharded_checkpoint(str(tmp_path / "ck2"))
+    arg3, _ = mod3.get_params()
+    for k in new_w:
+        assert np.array_equal(arg3[k].asnumpy(),
+                              new_w[k].asnumpy()), k
+
+
+class _FakeIter:
+    def __init__(self, state):
+        self._state = state
+        self.loaded = None
+
+    def state_dict(self):
+        return self._state
+
+    def load_state_dict(self, state):
+        self.loaded = state
+
+
+# ------------------------------------------------- elastic plumbing
+def test_kill_fault_kind_parse_rules():
+    assert rz.parse_fault_spec("elastic:rank0:3:kill") == \
+        [("elastic", "rank0", 3, "kill")]
+    with pytest.raises(ValueError, match="'kill' only"):
+        rz.parse_fault_spec("collective:allreduce:1:kill")
+
+
+def test_elastic_probe_counts_per_rank(monkeypatch):
+    """elastic:rank<N> fires on the right rank's nth step (the kill
+    itself is exercised subprocess-side in test_dist_launch)."""
+    from incubator_mxnet_tpu import dist
+    monkeypatch.setenv("MXTPU_FAULT_SPEC", "elastic:rank0:2:error")
+    rz.reset_faults()
+    dist.elastic_probe()                      # 1st call: no fire
+    with pytest.raises(rz.TransientError):
+        dist.elastic_probe()                  # 2nd call: fires
+
+
+def test_exithook_codes(monkeypatch):
+    """Uncaught CollectiveAbortedError exits 14 under elastic mode,
+    crashes normally otherwise; ElasticRestartRequested always 14."""
+    import subprocess
+    import sys
+    prog = (
+        "import os\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "from incubator_mxnet_tpu import resilience as rz\n"
+        "rz.install_diverged_exithook()\n"
+        "import sys\n"
+        "kind = sys.argv[1]\n"
+        "if kind == 'abort':\n"
+        "    raise rz.CollectiveAbortedError('peer died')\n"
+        "if kind == 'coll_deadline':\n"
+        "    e = rz.DeadlineExceededError('collective hung')\n"
+        "    e.collective = True\n"
+        "    raise e\n"
+        "if kind == 'local_deadline':\n"
+        "    raise rz.DeadlineExceededError('local disk wedged')\n"
+        "raise rz.ElasticRestartRequested('re-admit me')\n")
+    env = dict(os.environ, MXTPU_ELASTIC="1")
+    r = subprocess.run([sys.executable, "-c", prog, "abort"],
+                       env=env, capture_output=True, timeout=120)
+    assert r.returncode == rz.ELASTIC_EXIT_CODE, r.stderr[-500:]
+    env_off = dict(os.environ)
+    env_off.pop("MXTPU_ELASTIC", None)
+    r = subprocess.run([sys.executable, "-c", prog, "abort"],
+                       env=env_off, capture_output=True, timeout=120)
+    assert r.returncode == 1
+    r = subprocess.run([sys.executable, "-c", prog, "request"],
+                       env=env_off, capture_output=True, timeout=120)
+    assert r.returncode == rz.ELASTIC_EXIT_CODE
+    # only COLLECTIVE deadline expiries (tagged by dist._guarded)
+    # take the elastic exit — a local one means THIS rank is sick
+    # and must look like a crash so the policy shrinks it out
+    r = subprocess.run([sys.executable, "-c", prog, "coll_deadline"],
+                       env=env, capture_output=True, timeout=120)
+    assert r.returncode == rz.ELASTIC_EXIT_CODE
+    r = subprocess.run([sys.executable, "-c", prog,
+                        "local_deadline"],
+                       env=env, capture_output=True, timeout=120)
+    assert r.returncode == 1
+
+
+def test_dist_shutdown_is_reentrant():
+    from incubator_mxnet_tpu import dist
+    dist.shutdown()          # never initialized: clean no-op
+    assert not dist.is_initialized()
+
+
+# ----------------------------------------------------------- lint
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_lint():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "lint", os.path.join(REPO, "ci", "lint.py"))
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+    return lint
+
+
+def test_lint_forbids_bare_wb_in_checkpoint_module(tmp_path):
+    """parallel/checkpoint.py joined the atomic-write rule's module
+    list: a bare open(..., 'wb') there is a torn-write hazard."""
+    lint = _load_lint()
+    d = tmp_path / "incubator_mxnet_tpu" / "parallel"
+    d.mkdir(parents=True)
+    f = d / "checkpoint.py"
+    f.write_text("def save(p, b):\n"
+                 "    with open(p, 'wb') as fh:\n"
+                 "        fh.write(b)\n")
+    assert any("bare open" in p for p in lint.check_file(f))
+
+
+def test_lint_requires_fault_scope_documented(tmp_path,
+                                              monkeypatch):
+    """Every literal inject()/fault_for() scope must appear in the
+    docs/resilience.md grammar (the new-fault-scope satellite)."""
+    lint = _load_lint()
+    monkeypatch.chdir(REPO)
+    from pathlib import Path
+    d = tmp_path / "incubator_mxnet_tpu"
+    d.mkdir()
+    f = d / "probe.py"
+    f.write_text("from .resilience import inject\n"
+                 "def go():\n"
+                 "    inject('totally_new_scope', 'op')\n")
+    probs = lint.check_fault_scopes([Path(f)])
+    assert any("totally_new_scope" in p for p in probs), probs
+    f.write_text("from .resilience import inject\n"
+                 "def go(r):\n"
+                 "    inject('elastic', 'rank%d' % r)\n")
+    assert lint.check_fault_scopes([Path(f)]) == []
+    # and the live tree is clean under the rule
+    files = sorted(Path("incubator_mxnet_tpu").rglob("*.py")) \
+        + sorted(Path("tools").rglob("*.py"))
+    assert lint.check_fault_scopes(files) == []

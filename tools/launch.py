@@ -51,6 +51,12 @@ import time
 # launcher deliberately never imports the package (it must run
 # before jax is installed/importable on a fresh host)
 DIVERGED_EXIT = 13
+# resilience.ELASTIC_EXIT_CODE, mirrored by value: a worker exits
+# with this after a coordinated elastic abort (peer died inside a
+# collective) or a deliberate restart request (re-admission at a
+# checkpoint boundary) — with --elastic the restart ledger counts it
+# separately from crashes and divergence
+ELASTIC_EXIT = 14
 
 
 def _free_port():
@@ -86,13 +92,23 @@ def _assign_hosts(hosts, n):
     return [pool[r % len(pool)] for r in range(n)]
 
 
-def _worker_env(args, rank, coord, attempt):
+def _worker_env(args, rank, coord, attempt, world=None):
     env = {
-        "MXTPU_NUM_WORKERS": str(args.num_workers),
+        "MXTPU_NUM_WORKERS": str(world if world is not None
+                                 else args.num_workers),
         "MXTPU_WORKER_RANK": str(rank),
         "MXTPU_COORD_ADDR": coord,
         "MXTPU_RESTART_ATTEMPT": str(attempt),
+        # which world a metric/log line came from: generation 1 is
+        # the first launch, each restart (crash, divergence, or
+        # elastic resize) increments it
+        "MXTPU_WORLD_GENERATION": str(attempt + 1),
     }
+    if getattr(args, "elastic", False):
+        # workers map uncaught CollectiveAbortedError / collective
+        # deadline expiry to the distinct elastic exit (14) instead
+        # of a crash (resilience.install_diverged_exithook)
+        env["MXTPU_ELASTIC"] = "1"
     if getattr(args, "data_timeout", None) is not None:
         # input pipelines must fail before the whole job looks hung:
         # a worker whose data stalls raises DataPipelineError (a
@@ -129,11 +145,11 @@ def _ssh_argv(args, host, remote_cmd):
     return base + [host, remote_cmd]
 
 
-def _remote_command(args, rank, coord, attempt, cmd):
+def _remote_command(args, rank, coord, attempt, cmd, world=None):
     """One POSIX-shell line: cd to the launch cwd, export env inline,
     exec the training command (the reference tracker's export+exec
     pattern over ssh)."""
-    env = _worker_env(args, rank, coord, attempt)
+    env = _worker_env(args, rank, coord, attempt, world)
     if os.environ.get("PYTHONPATH"):
         env.setdefault("PYTHONPATH", os.environ["PYTHONPATH"])
     assigns = " ".join(f"{k}={shlex.quote(v)}"
@@ -382,10 +398,16 @@ def _run_once(spawners, hb_files=None, hb_timeout=0,
     With status_interval > 0 the monitor additionally aggregates the
     telemetry snapshots riding the heartbeat files into one periodic
     cluster status line (throughput, stragglers, error counters) —
-    the operator's view of *where* a slow job is slow."""
+    the operator's view of *where* a slow job is slow.
+
+    Returns ``(rc, failed_ranks)`` — the ranks observed to fail on
+    their own (crash exit or hung-kill), as opposed to peers torn
+    down by the job teardown; the --elastic restart policy shrinks
+    the next world by exactly these ranks."""
     procs = []
     next_status = time.time() + status_interval \
         if status_interval > 0 and hb_files else None
+    failed = set()
     try:
         for spawn in spawners:
             procs.append(spawn())
@@ -424,9 +446,10 @@ def _run_once(spawners, hb_files=None, hb_timeout=0,
                     print(f"launch.py: worker {r} exited with "
                           f"{code}; terminating the job",
                           file=sys.stderr)
+                    failed.add(r)
                     rc = code or 1
             time.sleep(0.05)
-        return rc
+        return rc, failed
     finally:
         for p in procs:
             if p.poll() is None:
@@ -504,12 +527,29 @@ def main():
                     "worker rolls back to its newest valid "
                     "checkpoint and exits with the divergence code")
     ap.add_argument("--max-restarts", type=int, default=0,
-                    help="elastic mode: relaunch the whole job up to "
-                    "N times after a worker failure (workers resume "
+                    help="relaunch the whole job up to N times after "
+                    "a worker crash or divergence (workers resume "
                     "from their last checkpoint; collective training "
                     "cannot continue around a dead rank, so restart "
                     "is whole-job, the reference's scheduler-restart "
                     "model)")
+    ap.add_argument("--elastic", action="store_true",
+                    help="elastic restarts (docs/elastic.md): a "
+                    "crashed/hung rank shrinks the next world to the "
+                    "surviving rank set; a worker exiting with the "
+                    "elastic code (14: coordinated collective abort "
+                    "or a deliberate restart request) relaunches the "
+                    "full target world, re-admitting replaced "
+                    "workers at the checkpoint boundary the restart "
+                    "resumes from.  Workers see MXTPU_ELASTIC=1 and "
+                    "a fresh MXTPU_WORLD_GENERATION per world; "
+                    "requires reshardable (sharded-manifest) "
+                    "checkpoints to resume onto the changed world")
+    ap.add_argument("--max-elastic-restarts", type=int, default=3,
+                    help="elastic restarts budget (counted and "
+                    "logged separately from --max-restarts, which "
+                    "keeps counting crashes without --elastic and "
+                    "divergence always)")
     ap.add_argument("command", nargs=argparse.REMAINDER,
                     help="training command")
     args = ap.parse_args()
@@ -538,11 +578,12 @@ def main():
         hb_dir = tempfile.mkdtemp(prefix="mxtpu_hb_")
 
     if args.launcher == "local":
-        def make_spawners(coord, attempt):
+        def make_spawners(coord, attempt, world):
             spawners = []
-            for r in range(args.num_workers):
+            for r in range(world):
                 env = dict(os.environ)
-                env.update(_worker_env(args, r, coord, attempt))
+                env.update(_worker_env(args, r, coord, attempt,
+                                       world))
                 if hb_dir is not None:
                     env["MXTPU_HEARTBEAT_FILE"] = \
                         _hb_path(hb_dir, attempt, r)
@@ -560,23 +601,49 @@ def main():
     elif args.launcher == "ssh":
         if not args.hostfile:
             ap.error("--launcher ssh requires -H/--hostfile")
-        hosts = _parse_hostfile(args.hostfile)
-        ranks = _assign_hosts(hosts, args.num_workers)
+        hosts_all = _parse_hostfile(args.hostfile)
+        # elastic ssh state: the live host pool shrinks when a
+        # rank's host fails (its machine may be gone — re-spawning
+        # on it would burn the whole elastic budget against a dead
+        # box) and is restored in full on a grow restart; the rank
+        # assignment AND the coordinator re-derive from the live
+        # pool each attempt, so the coordinator never stays pinned
+        # to a failed host
+        ssh_live = {"hosts": list(hosts_all), "ranks": []}
 
         def coord_for(attempt):
-            return f"{ranks[0]}:{args.port + attempt}"
+            host = _assign_hosts(ssh_live["hosts"], 1)[0]
+            return f"{host}:{args.port + attempt}"
 
-        def make_spawners(coord, attempt):
+        def make_spawners(coord, attempt, world):
+            ranks = _assign_hosts(ssh_live["hosts"], world)
+            ssh_live["ranks"] = ranks
             spawners = []
-            for r in range(args.num_workers):
+            for r in range(world):
                 argv = _ssh_argv(
                     args, ranks[r],
-                    _remote_command(args, r, coord, attempt, cmd))
+                    _remote_command(args, r, coord, attempt, cmd,
+                                    world))
 
                 def spawn(argv=argv):
                     return subprocess.Popen(argv)
                 spawners.append(spawn)
             return spawners
+
+        def drop_failed_hosts(failed):
+            assigned = ssh_live["ranks"]
+            bad = {assigned[r] for r in failed
+                   if r < len(assigned)}
+            live = [(h, s) for h, s in ssh_live["hosts"]
+                    if h not in bad]
+            if live:
+                ssh_live["hosts"] = live
+                print(f"launch.py: excluding failed host(s) "
+                      f"{sorted(bad)} from the next world",
+                      file=sys.stderr)
+
+        def restore_hosts():
+            ssh_live["hosts"] = list(hosts_all)
 
     elif args.launcher == "mpi":
         mpirun = shutil.which("mpirun")
@@ -613,37 +680,92 @@ def main():
             print(_remote_command(args, r, coord, 0, cmd))
         return 0
 
-    def hb_files(attempt):
+    if args.launcher == "local":
+        # single host: shrink/grow only changes the world size
+        def drop_failed_hosts(failed):
+            pass
+
+        def restore_hosts():
+            pass
+
+    def hb_files(attempt, world):
         if hb_dir is None:
             return None
         return {r: _hb_path(hb_dir, attempt, r)
-                for r in range(args.num_workers)}
+                for r in range(world)}
 
+    # restart ledger: crashes/divergence count against
+    # --max-restarts (unchanged semantics), elastic world changes
+    # against their own budget with their own log line, so an
+    # operator reading the log can tell "the world resized twice"
+    # from "it crashed twice" at a glance
+    world = args.num_workers
+    attempt = 0
+    crash_restarts = 0
+    elastic_restarts = 0
     try:
-        last_files = hb_files(0)
-        coord = coord_for(0)
-        rc = _run_once(make_spawners(coord, 0), last_files,
-                       args.heartbeat_timeout, args.status_interval)
-        for attempt in range(1, args.max_restarts + 1):
+        while True:
+            last_files = hb_files(attempt, world)
+            rc, failed = _run_once(
+                make_spawners(coord_for(attempt), attempt, world),
+                last_files, args.heartbeat_timeout,
+                args.status_interval)
             if rc == 0:
                 break
-            if rc == DIVERGED_EXIT:
-                print(f"launch.py: worker reported DIVERGENCE (exit "
-                      f"{rc}: MXTPU_MAX_BAD_STEPS consecutive "
-                      "non-finite steps); params were rolled back to "
-                      "the newest valid checkpoint — restarting "
-                      f"(attempt {attempt}/{args.max_restarts}) "
-                      "resumes from it", file=sys.stderr)
-            else:
-                print(f"launch.py: restarting job (attempt {attempt}/"
-                      f"{args.max_restarts}); workers should resume "
-                      "from their last checkpoint (params + optimizer "
-                      ".states + input-pipeline .data companions)",
+            if args.elastic and rc != DIVERGED_EXIT:
+                if elastic_restarts >= args.max_elastic_restarts:
+                    print("launch.py: elastic restart budget spent "
+                          f"({elastic_restarts}/"
+                          f"{args.max_elastic_restarts}); giving up",
+                          file=sys.stderr)
+                    break
+                elastic_restarts += 1
+                prev_world = world
+                if rc == ELASTIC_EXIT:
+                    # coordinated abort / deliberate restart request:
+                    # the rank that exited 14 is healthy — relaunch
+                    # the full target world, re-admitting any
+                    # previously shrunk-out worker (and host) at the
+                    # checkpoint boundary the resume lands on
+                    world = args.num_workers
+                    restore_hosts()
+                    why = "grow: re-admitting replaced worker(s) " \
+                        "at the checkpoint boundary" \
+                        if world > prev_world else \
+                        "coordinated abort: same world"
+                else:
+                    world = max(1, prev_world - max(1, len(failed)))
+                    drop_failed_hosts(failed)
+                    why = (f"shrink: rank(s) {sorted(failed)} "
+                           "failed") if failed else \
+                        "shrink: a rank was lost"
+                print(f"launch.py: ELASTIC restart "
+                      f"{elastic_restarts}/"
+                      f"{args.max_elastic_restarts}: world "
+                      f"{prev_world} -> {world} ({why}); workers "
+                      "resume from the newest sharded checkpoint "
+                      "generation, resharded onto the new world",
                       file=sys.stderr)
-            last_files = hb_files(attempt)
-            rc = _run_once(make_spawners(coord_for(attempt), attempt),
-                           last_files, args.heartbeat_timeout,
-                           args.status_interval)
+            else:
+                if crash_restarts >= args.max_restarts:
+                    break
+                crash_restarts += 1
+                if rc == DIVERGED_EXIT:
+                    print(f"launch.py: worker reported DIVERGENCE "
+                          f"(exit {rc}: MXTPU_MAX_BAD_STEPS "
+                          "consecutive non-finite steps); params "
+                          "were rolled back to the newest valid "
+                          "checkpoint — restarting (attempt "
+                          f"{crash_restarts}/{args.max_restarts}) "
+                          "resumes from it", file=sys.stderr)
+                else:
+                    print("launch.py: restarting job (attempt "
+                          f"{crash_restarts}/{args.max_restarts}); "
+                          "workers should resume from their last "
+                          "checkpoint (params + optimizer .states + "
+                          "input-pipeline .data companions)",
+                          file=sys.stderr)
+            attempt += 1
         # final run report from the exited workers' last snapshots
         # (the heartbeat files persist until the cleanup below)
         if last_files:
