@@ -880,6 +880,186 @@ def _bench_serving_slo(dev, platform):
     }))
 
 
+def _bench_serving_fleet(dev, platform):
+    """Serving-fleet failover bench (ISSUE 16 acceptance): a fixed-
+    seed Poisson request stream over a 3-replica CPU fleet with one
+    replica hard-killed mid-stream (``router:replica:N:kill`` —
+    ``os._exit``, no teardown).  Reports failover latency (link-down
+    to first re-dispatched token, the ``router_failover_seconds``
+    histogram), verifies zero lost and zero duplicated terminals
+    fleet-wide, and checks every surviving output bitwise-equal to an
+    unkilled single-engine run of the same stream.  CPU-measurable;
+    writes the BENCH_r16.json artifact."""
+    import subprocess
+    import tempfile
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import telemetry, tracing
+    from incubator_mxnet_tpu.serving import ServingEngine
+    from incubator_mxnet_tpu.serving.replica import _build_tiny
+    from incubator_mxnet_tpu.serving.router import ServingRouter
+
+    del dev
+    rs = np.random.RandomState(16)
+    n_req = int(os.environ.get("MXTPU_BENCH_FLEET_REQS", "24"))
+    n_replicas, max_new, max_batch = 3, 8, 2
+    kill_nth = 5        # replica 0 dies serving its 5th dispatch
+    net = _build_tiny("")       # the same weights every replica holds
+    vocab = 37
+    prompts = [list(rs.randint(0, vocab, int(rs.randint(3, 12))))
+               for _ in range(n_req)]
+    eng_kw = dict(max_batch=max_batch, block_size=4, num_blocks=64,
+                  prefix_cache=False, queue_limit=0)
+
+    # ---- reference: the same stream through ONE unkilled engine ----
+    _stage(f"single-engine reference ({n_req} requests x {max_new} "
+           "new tokens)", tag="fleet")
+    eng = ServingEngine(net, **eng_kw)
+    for p in prompts[:2]:       # warm prefill buckets + decode step
+        eng.submit(p, max_new)
+    eng.run()
+    ids = [eng.submit(p, max_new).id for p in prompts]
+    t0 = time.perf_counter()
+    ref_out = eng.run()
+    ref_wall = time.perf_counter() - t0
+    refs = [ref_out[i] for i in ids]
+    cap_req_s = n_req / ref_wall
+    _stage(f"single-engine capacity ~{cap_req_s:.1f} req/s",
+           tag="fleet")
+
+    # ---- fleet pass: 3 replicas, one killed mid-stream -------------
+    # fixed-seed Poisson arrivals at 1x single-engine capacity: the
+    # 3-replica fleet absorbs it with headroom, so the measured
+    # failover cost is the fault's, not queueing's
+    arrivals = np.cumsum(np.random.RandomState(1611).exponential(
+        1.0 / cap_req_s, n_req))
+    repo = os.path.dirname(os.path.abspath(__file__))
+    tmp = tempfile.mkdtemp(prefix="mxtpu_fleet_bench_")
+    procs, port_files = [], []
+    for i in range(n_replicas):
+        pf = os.path.join(tmp, f"port{i}")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("MXTPU_FAULT_SPEC", None)
+        if i == 0:
+            env["MXTPU_FAULT_SPEC"] = \
+                f"router:replica:{kill_nth}:kill"
+        log = open(os.path.join(tmp, f"replica{i}.log"), "wb")
+        procs.append((subprocess.Popen(
+            [sys.executable, "-m",
+             "incubator_mxnet_tpu.serving.replica",
+             "--port-file", pf, "--name", f"bench{i}",
+             "--max-batch", str(max_batch), "--block-size", "4",
+             "--num-blocks", "64", "--prefix-cache", "0"],
+            cwd=repo, env=env, stdout=log, stderr=log), log))
+    _stage(f"booting {n_replicas} replica processes "
+           f"(replica0 dies on dispatch #{kill_nth})", tag="fleet")
+    deadline = time.monotonic() + 180
+    while time.monotonic() < deadline:
+        if all(os.path.exists(os.path.join(tmp, f"port{i}"))
+               for i in range(n_replicas)):
+            break
+        time.sleep(0.1)
+    ports = [int(open(os.path.join(tmp, f"port{i}")).read())
+             for i in range(n_replicas)]
+
+    tracing.get_recorder().clear()
+    router = ServingRouter(
+        replicas=[("127.0.0.1", p) for p in ports],
+        poll_interval=0.02, stale_after=5.0).connect()
+    try:
+        _stage("replaying Poisson stream through the router",
+               tag="fleet")
+        pending = list(zip(arrivals, prompts))
+        reqs = []
+        t0 = time.perf_counter()
+        while pending:
+            now = time.perf_counter() - t0
+            while pending and pending[0][0] <= now:
+                _arr, p = pending.pop(0)
+                reqs.append(router.submit(p, max_new,
+                                          deadline=300.0))
+            router.poll()
+            time.sleep(0.005)
+        router.wait(reqs, timeout=300.0)
+        fleet_wall = time.perf_counter() - t0
+
+        finished = [r for r in reqs if r.state == "finished"]
+        lost = len(reqs) - len(finished)
+        dup = sum(
+            1 for r in reqs
+            if len(tracing.events("router_terminal", rid=r.id)) != 1)
+        mismatched = sum(1 for r, ref in zip(reqs, refs)
+                         if r.state == "finished"
+                         and r.tokens != ref)
+        redispatches = sum(r.redispatches for r in reqs)
+        failover = telemetry.get_registry().histogram(
+            "router_failover_seconds").stats()
+        killed_rc = procs[0][0].wait(timeout=60)
+        leaks = {}
+        for name in ("replica1", "replica2"):
+            st = router.replica_stats(name)
+            leaks[name] = {"num_allocated": st["num_allocated"],
+                           "pool_live": st["pool_live"]}
+        _stage("draining survivors", tag="fleet")
+        drained = sorted(router.drain(wait=True, timeout=60.0))
+        survivor_rcs = [p.wait(timeout=60) for p, _ in procs[1:]]
+    finally:
+        router.close()
+        for p, log in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)
+            log.close()
+
+    ok = (lost == 0 and dup == 0 and mismatched == 0
+          and redispatches >= 1 and killed_rc != 0
+          and all(v["num_allocated"] == 0 for v in leaks.values()))
+    artifact = {
+        "metric": "serving_fleet_failover",
+        "platform": platform,
+        "fleet": {"replicas": n_replicas, "max_batch": max_batch,
+                  "killed": "replica0",
+                  "kill_spec": f"router:replica:{kill_nth}:kill"},
+        "stream": {"requests": n_req, "max_new_tokens": max_new,
+                   "arrival_rate_req_per_s": round(cap_req_s, 2),
+                   "arrival_seed": 1611,
+                   "single_engine_wall_s": round(ref_wall, 3),
+                   "fleet_wall_s": round(fleet_wall, 3)},
+        "failover": {
+            "redispatched_requests": redispatches,
+            "latency_s": {k: (round(v, 4)
+                              if isinstance(v, float) else v)
+                          for k, v in failover.items()},
+            "note": "link-down to first re-dispatched token; on CPU "
+                    "this is dominated by the survivors' cold "
+                    "prefill-bucket jit compiles for the re-homed "
+                    "prompt lengths (a production fleet pre-warms "
+                    "buckets at boot)"},
+        "terminals": {"finished": len(finished), "lost": lost,
+                      "duplicated": dup,
+                      "token_mismatches": mismatched},
+        "killed_replica_exit_code": killed_rc,
+        "survivor_exit_codes": survivor_rcs,
+        "survivor_block_leaks": leaks,
+        "drained": drained,
+        "all_invariants_held": ok,
+    }
+    out_path = os.path.join(repo, "BENCH_r16.json")
+    with open(out_path, "w") as f:
+        f.write(json.dumps(artifact, indent=2) + "\n")
+    print(json.dumps({
+        "metric": "serving_fleet_failover",
+        "value": artifact["failover"]["latency_s"].get("p50"),
+        "unit": "s_failover_p50",
+        "platform": platform,
+        "redispatched": redispatches,
+        "lost": lost, "duplicated": dup,
+        "token_mismatches": mismatched,
+        "all_invariants_held": ok,
+        "artifact": "BENCH_r16.json",
+    }))
+
+
 def _bench_tracing(dev, platform):
     """Flight-recorder bench (ISSUE 9 acceptance): the serving
     stream from the ISSUE 7 bench run (a) with MXTPU_TELEMETRY=0 and
@@ -1483,6 +1663,9 @@ def main():
         return
     if os.environ.get("MXTPU_BENCH_MODEL") == "serving_slo":
         _bench_serving_slo(dev, platform)
+        return
+    if os.environ.get("MXTPU_BENCH_MODEL") == "serving_fleet":
+        _bench_serving_fleet(dev, platform)
         return
     if os.environ.get("MXTPU_BENCH_MODEL") == "tracing":
         _bench_tracing(dev, platform)

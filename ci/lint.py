@@ -103,6 +103,23 @@ SYNC_ATTRS = {"item", "asscalar", "asnumpy"}
 SYNC_ROOT_ATTRS = {("np", "asarray"), ("numpy", "asarray"),
                    ("jax", "device_get")}
 
+# Serving RPC transport files (docs/serving.md "Fleet").  In these,
+# an unbounded socket wait — .recv()/.accept()/.connect()/
+# .create_connection() with no timeout kwarg — is forbidden: a peer
+# that died mid-frame would park the reader (or the router's dispatch
+# path) forever, which the fleet reads as a healthy-but-silent
+# replica.  Every wait must arm the per-call deadline
+# (rpc._deadline + settimeout) or pass timeout=; a deliberate
+# exception carries `# deadline-ok: <why>` on the line or in the
+# comment block directly above it.
+SOCKET_WAIT_FILES = (
+    "incubator_mxnet_tpu/serving/rpc.py",
+    "incubator_mxnet_tpu/serving/router.py",
+    "incubator_mxnet_tpu/serving/replica.py",
+)
+SOCKET_WAIT_ATTRS = {"recv", "accept", "connect",
+                     "create_connection"}
+
 # Deadline/timeout modules (serving SLOs + the resilience layer's
 # deadline machinery; docs/serving.md "SLOs, shedding, and drain").
 # In these, bare ``time.time()`` is forbidden: the wall clock jumps
@@ -287,6 +304,45 @@ def _graph_mutation_problems(path, tree, lines):
     return problems
 
 
+def _socket_wait_problems(path, tree, lines):
+    """Flag unbounded socket waits in the serving RPC layer
+    (SOCKET_WAIT_FILES x SOCKET_WAIT_ATTRS).  A call is bounded when
+    it passes ``timeout=``; otherwise it needs a ``deadline-ok``
+    annotation on its line or in the comment block directly above
+    (the rpc.py pattern: ``settimeout`` armed from the per-call
+    deadline right before the wait, annotation documenting it)."""
+    problems = []
+
+    def _annotated(lineno):
+        if lineno - 1 < len(lines) \
+                and "deadline-ok" in lines[lineno - 1]:
+            return True
+        i = lineno - 2
+        while i >= 0 and lines[i].lstrip().startswith("#"):
+            if "deadline-ok" in lines[i]:
+                return True
+            i -= 1
+        return False
+
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in SOCKET_WAIT_ATTRS):
+            continue
+        if any(kw.arg == "timeout" for kw in node.keywords):
+            continue
+        if _annotated(node.lineno):
+            continue
+        problems.append(
+            f"{path}:{node.lineno}: unbounded socket "
+            f".{node.func.attr}() in the serving RPC layer — a dead "
+            "peer parks this wait forever; arm settimeout from the "
+            "per-call deadline (rpc._deadline/_remaining) or pass "
+            "timeout=, or annotate the line (or the comment block "
+            "above it) with '# deadline-ok: <why>'")
+    return problems
+
+
 def _imported_names(tree):
     """name -> lineno for every import binding."""
     out = {}
@@ -338,6 +394,9 @@ def check_file(path):
     if any(posix.endswith(m) for m in HOT_SYNC_FILES):
         problems.extend(
             _hot_sync_problems(path, tree, src.splitlines()))
+    if any(posix.endswith(m) for m in SOCKET_WAIT_FILES):
+        problems.extend(
+            _socket_wait_problems(path, tree, src.splitlines()))
     if "incubator_mxnet_tpu" in posix and \
             not any(d in posix for d in GRAPH_MUTATION_DIRS):
         problems.extend(

@@ -198,7 +198,15 @@ class RetryPolicy:
     """Bounded exponential backoff: delay_i = min(base * 2**i, max),
     each widened by up to ``jitter`` fraction (decorrelates workers
     hammering a recovering coordinator).  ``seed`` makes the jitter
-    sequence deterministic (tests)."""
+    sequence deterministic (tests).
+
+    ``jitter=True`` (the bool, not a fraction) selects *full* jitter:
+    delay_i ~ U(0, min(base * 2**i, max)).  Fractional jitter only
+    spreads retries across ``jitter``x the base delay, so N fleet
+    links reconnecting after the same router blip still arrive in a
+    tight wave; full jitter spreads them across the whole backoff
+    window (the rpc.py reconnect paths all use it).  The default
+    (env-fraction) behavior is unchanged."""
 
     def __init__(self, max_retries=None, base_delay=None,
                  max_delay=None, jitter=None, seed=None):
@@ -217,7 +225,9 @@ class RetryPolicy:
         out = []
         for i in range(self.max_retries):
             d = min(self.base_delay * (2 ** i), self.max_delay)
-            if self.jitter:
+            if self.jitter is True:
+                d = self._rng.uniform(0.0, d)
+            elif self.jitter:
                 d += d * self.jitter * self._rng.random()
             out.append(d)
         return out
@@ -428,15 +438,24 @@ def parse_fault_spec(raw):
             raise ValueError(
                 f"bad fault spec {entry!r}: kind {kind!r} not in "
                 f"{_FAULT_KINDS}")
-        if kind in ("truncate", "corrupt") and \
+        if kind == "truncate" and \
                 scope not in ("checkpoint", "record"):
             # data-path kinds only have an effect where file bytes
             # flow (checkpoint writes, recordio reads); accepting
             # them elsewhere would validate a spec that injects
             # nothing
             raise ValueError(
-                f"bad fault spec {entry!r}: kind {kind!r} only "
+                f"bad fault spec {entry!r}: kind 'truncate' only "
                 "applies to the 'checkpoint' and 'record' scopes")
+        if kind == "corrupt" and \
+                scope not in ("checkpoint", "record", "router"):
+            # corrupt additionally applies where frame bytes flow:
+            # router:net garbles one payload byte after the CRC is
+            # computed (serving/rpc.py send path)
+            raise ValueError(
+                f"bad fault spec {entry!r}: kind 'corrupt' only "
+                "applies to the 'checkpoint', 'record' and "
+                "'router' scopes")
         if kind in ("nan", "inf") and scope not in ("grad", "loss"):
             raise ValueError(
                 f"bad fault spec {entry!r}: kind {kind!r} only "
@@ -445,14 +464,16 @@ def parse_fault_spec(raw):
             raise ValueError(
                 f"bad fault spec {entry!r}: kind 'spike' only "
                 "applies to the 'loss' scope")
-        if kind == "kill" and scope != "elastic":
-            # hard process death is the elastic layer's test vector
-            # (rank dies mid-step, docs/elastic.md); accepting it on
-            # scopes with in-process recovery semantics would just
-            # kill the test harness
+        if kind == "kill" and scope not in ("elastic", "router"):
+            # hard process death is a cross-process layer's test
+            # vector (a rank dying mid-step for the elastic restart
+            # loop, a replica dying mid-dispatch for the router's
+            # failover re-dispatch); accepting it on scopes with
+            # in-process recovery semantics would just kill the test
+            # harness
             raise ValueError(
                 f"bad fault spec {entry!r}: kind 'kill' only "
-                "applies to the 'elastic' scope")
+                "applies to the 'elastic' and 'router' scopes")
         if nth != "*":
             try:
                 nth = int(nth)
@@ -514,8 +535,9 @@ def inject(scope, op):
     the numeric kinds ``nan``/``inf``/``spike`` for the
     step-sentinel callers (guarded updaters poison a gradient,
     check_loss poisons the loss — docs/numeric_stability.md);
-    ``kill`` (scope ``elastic`` only) hard-exits the process
-    mid-step, the elastic restart loop's test vector."""
+    ``kill`` (scopes ``elastic`` and ``router``) hard-exits the
+    process — the elastic restart loop's and the serving router's
+    failover test vector."""
     kind = fault_for(scope, op)
     if kind == "error":
         raise TransientError(

@@ -11,12 +11,21 @@ reference's C predict ABI never needed to be.
         ...
 
 Or over an exported artifact: ``predictor.serve(param_file, model)``.
+
+Multi-replica fleet (docs/serving.md "Fleet"): ``ServingRouter``
+spreads requests over N ``ReplicaServer`` processes speaking the
+``rpc`` frame protocol, with prefix-affinity routing, circuit
+breakers, and failover re-dispatch.
 """
 from .block_table import BlockPool, BlockPoolExhausted
 from .cache_manager import PrefixCache
 from .engine import ServingEngine
 from .quantize import (quantization_error, quantize_weights,
                        weights_nbytes)
+from .replica import ReplicaServer
+from .router import FleetRequest, ServingRouter
+from .rpc import (RpcClient, RpcError, RpcFrameError, RpcServer,
+                  RpcTimeoutError)
 from .scheduler import (CANCELLED, EXPIRED, FAILED, FINISHED, QUEUED,
                         RUNNING, TERMINAL_STATES, Request,
                         RequestTooLargeError, Scheduler,
@@ -29,4 +38,6 @@ __all__ = ["ServingEngine", "BlockPool", "BlockPoolExhausted",
            "RequestTooLargeError", "quantize_weights",
            "quantization_error", "weights_nbytes", "QUEUED",
            "RUNNING", "FINISHED", "FAILED", "EXPIRED", "CANCELLED",
-           "TERMINAL_STATES"]
+           "TERMINAL_STATES", "ServingRouter", "FleetRequest",
+           "ReplicaServer", "RpcClient", "RpcServer", "RpcError",
+           "RpcTimeoutError", "RpcFrameError"]

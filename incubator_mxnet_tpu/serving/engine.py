@@ -767,70 +767,104 @@ class ServingEngine:
             if cfg.get(key) is not None:
                 engine_kw.setdefault(key, cfg[key])
         eng = cls(model, **engine_kw)
-        now = time.monotonic()
-        complete = []   # retired OUTSIDE the lock: _finalize takes it
+        for entry in snapshot["requests"]:
+            eng.resubmit(entry)
         with eng._submit_lock:
-            for entry in snapshot["requests"]:
-                req = Request(int(entry["id"]), entry["prompt"],
-                              entry["max_new_tokens"],
-                              eos_id=entry.get("eos_id"))
-                req.generated = [int(t)
-                                 for t in entry.get("generated", [])]
-                req.queue_wait_s = float(
-                    entry.get("queue_wait_s", 0.0))
-                req.prefill_s = float(entry.get("prefill_s", 0.0))
-                req.preemptions = int(entry.get("preemptions", 0))
-                rem = entry.get("deadline_remaining_s")
-                if rem is not None:
-                    req.deadline_ts = now + float(rem)
-                rem = entry.get("ttft_remaining_s")
-                # a request whose first token shipped pre-crash met
-                # its TTFT SLO; the re-prefill must not re-arm it —
-                # and must not re-emit serve_first_token or observe
-                # a second TTFT sample (lifecycle parity: one first
-                # token per request, ever)
-                if entry.get("ttft_done"):
-                    req.first_token_ts = now
-                    req.last_token_ts = now
-                elif rem is not None:
-                    req.ttft_deadline_ts = now + float(rem)
-                tracing.trace_event(
-                    "serve_enqueue", rid=req.id,
-                    engine=eng.engine_id,
-                    prompt_tokens=len(req.prompt),
-                    max_new_tokens=req.max_new_tokens,
-                    restored=True,
-                    generated_tokens=len(req.generated))
-                eng._prof_async("b", "request", req)
-                eng._prof_async("b", "queue_wait", req)
-                eng._live[req.id] = req
-                eng._m_requests.inc()
-                # a snapshot can catch a request BETWEEN its last
-                # generated token and its same-iteration retirement
-                # (req.done latches at _retire): that request is
-                # already complete — re-queueing it would decode
-                # one token past its budget/EOS and break the
-                # token-identical resume guarantee
-                if (len(req.generated) >= req.max_new_tokens
-                        or (req.eos_id is not None and req.generated
-                            and req.generated[-1] == req.eos_id)):
-                    complete.append(req)
-                    continue
-                if req.ttft_deadline_ts is not None \
-                        or req.deadline_ts is not None:
-                    eng._deadlines_armed += 1
-                    eng._deadline_next = min(eng._deadline_next,
-                                             eng._next_deadline(req))
-                eng._sched.add(req)
             eng._next_id = max(
-                int(snapshot.get("next_id", 0)),
-                max((r["id"] for r in snapshot["requests"]),
-                    default=-1) + 1)
-        for req in complete:
-            eng._retire(req)    # exactly-one-terminal parity holds
+                eng._next_id, int(snapshot.get("next_id", 0)))
         tracing.trace_event("serve_restore", engine=eng.engine_id,
                             requests=len(snapshot["requests"]))
         return eng
+
+    def resubmit(self, entry, redispatch=False):
+        """Re-admit ONE request in :meth:`_snapshot_request` entry
+        form — the shared re-admission path under :meth:`restore`
+        (crash resume) and the fleet router's failover re-dispatch
+        (serving/router.py ships exactly this schema over rpc.py to
+        a surviving replica).  Continues by greedy recompute:
+        re-admission prefills ``prompt + generated``, so the
+        completed output is token-identical to an uninterrupted run.
+
+        Bypasses admission control deliberately — the request was
+        already admitted once (at the original engine or fleet-wide
+        at the router); shedding it here would turn one failure into
+        two.  Deadlines in the entry are REMAINING seconds and are
+        re-armed against this process's monotonic clock; a request
+        whose first token already shipped (``ttft_done``) does not
+        re-arm TTFT and never re-emits ``serve_first_token``
+        (lifecycle parity: one first token per request, ever).
+        Returns the :class:`Request`."""
+        now = time.monotonic()
+        complete = False   # retired OUTSIDE the lock: _finalize takes it
+        with self._submit_lock:
+            req = Request(int(entry["id"]), entry["prompt"],
+                          entry["max_new_tokens"],
+                          eos_id=entry.get("eos_id"))
+            req.generated = [int(t)
+                             for t in entry.get("generated", [])]
+            req.queue_wait_s = float(
+                entry.get("queue_wait_s", 0.0))
+            req.prefill_s = float(entry.get("prefill_s", 0.0))
+            req.preemptions = int(entry.get("preemptions", 0))
+            rem = entry.get("deadline_remaining_s")
+            if rem is not None:
+                req.deadline_ts = now + float(rem)
+            rem = entry.get("ttft_remaining_s")
+            # a request whose first token shipped pre-crash met
+            # its TTFT SLO; the re-prefill must not re-arm it —
+            # and must not re-emit serve_first_token or observe
+            # a second TTFT sample (lifecycle parity: one first
+            # token per request, ever)
+            if entry.get("ttft_done"):
+                req.first_token_ts = now
+                req.last_token_ts = now
+            elif rem is not None:
+                req.ttft_deadline_ts = now + float(rem)
+            tracing.trace_event(
+                "serve_enqueue", rid=req.id,
+                engine=self.engine_id,
+                prompt_tokens=len(req.prompt),
+                max_new_tokens=req.max_new_tokens,
+                restored=True, redispatch=bool(redispatch),
+                generated_tokens=len(req.generated))
+            self._prof_async("b", "request", req)
+            self._prof_async("b", "queue_wait", req)
+            self._live[req.id] = req
+            self._m_requests.inc()
+            self._next_id = max(self._next_id, req.id + 1)
+            # a snapshot can catch a request BETWEEN its last
+            # generated token and its same-iteration retirement
+            # (req.done latches at _retire): that request is
+            # already complete — re-queueing it would decode
+            # one token past its budget/EOS and break the
+            # token-identical resume guarantee
+            if (len(req.generated) >= req.max_new_tokens
+                    or (req.eos_id is not None and req.generated
+                        and req.generated[-1] == req.eos_id)):
+                complete = True
+            else:
+                if req.ttft_deadline_ts is not None \
+                        or req.deadline_ts is not None:
+                    self._deadlines_armed += 1
+                    self._deadline_next = min(
+                        self._deadline_next,
+                        self._next_deadline(req))
+                self._sched.add(req)
+        if complete:
+            self._retire(req)   # exactly-one-terminal parity holds
+        return req
+
+    def take_completed(self):
+        """Pop and return the terminal :class:`Request` objects
+        collected since the last ``run()``/``drain()``/
+        ``take_completed()`` — WITHOUT latching drain.  The fleet
+        replica's serve loop (serving/replica.py) consumes terminals
+        incrementally this way while staying open for new
+        dispatches; ``run()`` and ``drain()`` keep their
+        consume-on-return semantics."""
+        with self._submit_lock:
+            done, self._completed = self._completed, []
+        return done
 
     def install_sigterm(self, snapshot_path, drain=True):
         """Wire SIGTERM to snapshot-then-drain: the handler writes

@@ -58,7 +58,8 @@ from .utils.log import get_logger
 
 __all__ = ["FlightRecorder", "enabled", "get_recorder", "recorder",
            "trace_event", "events", "dump", "dump_on_fault",
-           "install_signal_dump", "compile_ledger", "CompileLedger",
+           "install_signal_dump", "stitch_dumps",
+           "compile_ledger", "CompileLedger",
            "signature_diff", "compile_totals", "register_memory",
            "register_param_opt_providers", "updater_state_arrays",
            "device_memory_stats", "update_memory_gauges",
@@ -359,6 +360,52 @@ def install_signal_dump(signums=None):
             return False
     _SIGNAL_STATE["installed"] = True
     return True
+
+
+def stitch_dumps(paths, rid=None):
+    """Merge flight-recorder dump files into one fleet timeline.
+
+    The router and each serving replica are separate processes, so
+    one request's hops — ``router_dispatch`` on the router,
+    ``fleet_dispatch``/``fleet_terminal`` on a replica,
+    ``router_terminal`` back on the router — land in separate dump
+    files (``MXTPU_TRACE_DUMP`` plus the per-rank suffix from
+    ``_dump_path``).  This loads every dump in ``paths``, tags each
+    event with its source file (``src`` = basename; the per-rank
+    suffix keeps these distinct), and returns one wall-clock-ordered
+    list, ties broken by source then per-source ``seq``.  Events
+    share a key: dispatch/terminal hops carry ``rid`` and
+    ``replica`` on both sides of the wire, so ``rid=`` narrows the
+    merge to a single request's cross-process story.
+
+    Paths that do not exist are skipped — a ``router:replica:kill``
+    fault dies by ``os._exit`` and never dumps; the surviving files
+    still stitch.  Header lines and undecodable lines are skipped
+    the same way (dumps are written atomically, but a glob may
+    match a foreign or torn file)."""
+    merged = []
+    for path in paths:
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                raw = fh.read().splitlines()
+        except OSError:
+            continue
+        src = os.path.basename(str(path))
+        for line in raw:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if not isinstance(rec, dict) or "event" not in rec:
+                continue            # header / foreign line
+            if rid is not None and rec.get("rid") != rid:
+                continue
+            rec = dict(rec)
+            rec["src"] = src
+            merged.append(rec)
+    merged.sort(key=lambda e: (e.get("ts", 0.0), e.get("src", ""),
+                               e.get("seq", 0)))
+    return merged
 
 
 # ---------------------------------------------------------------------------

@@ -135,6 +135,40 @@ register_env("MXTPU_SERVE_STEP_TIMEOUT", float, 0.0,
              "interruption: a wedged device call is the heartbeat "
              "monitor's job; 0 disables")
 
+# Serving fleet (serving/rpc.py, router.py, replica.py,
+# tools/launch.py --serve-fleet; docs/serving.md "Fleet").
+register_env("MXTPU_RPC_TIMEOUT", float, 30.0,
+             "default per-call deadline (s) for every fleet RPC "
+             "socket wait (connect, frame send, frame recv); rpc.py "
+             "refuses unbounded waits, so 0 is coerced to this "
+             "default rather than disabling the bound")
+register_env("MXTPU_ROUTER_PORT", int, 0,
+             "port ServingRouter.listen() binds its client-facing "
+             "RPC front door to; 0 = ephemeral (the bound port is "
+             "reported by listen())")
+register_env("MXTPU_FLEET_REPLICAS", int, 0,
+             "replica count exported by tools/launch.py "
+             "--serve-fleet to every fleet process; 0 = not "
+             "launcher-managed")
+register_env("MXTPU_BREAKER_THRESHOLD", int, 3,
+             "consecutive per-replica dispatch failures that trip "
+             "the router's circuit breaker from closed to open")
+register_env("MXTPU_BREAKER_COOLDOWN", float, 5.0,
+             "seconds an open breaker waits before half-open admits "
+             "exactly one probe request (monotonic clock)")
+register_env("MXTPU_FLEET_ROLE", str, "",
+             "role exported by tools/launch.py --serve-fleet to "
+             "each fleet process: 'router' or 'replica' (empty = "
+             "not fleet-launched)")
+register_env("MXTPU_REPLICA_ADDRS", str, "",
+             "comma-separated host:port list of replica RPC servers "
+             "exported by tools/launch.py --serve-fleet to the "
+             "router process")
+register_env("MXTPU_REPLICA_PORT", int, 0,
+             "port a replica worker binds its RPC server to "
+             "(exported per replica by tools/launch.py "
+             "--serve-fleet); 0 = ephemeral")
+
 # Resilience layer (resilience.py; docs/resilience.md).
 register_env("MXTPU_COLLECTIVE_TIMEOUT", float, 600.0,
              "wall-clock deadline (s) for dist collectives; a hung "

@@ -17,6 +17,11 @@ Usage:
     python tools/launch.py -n 8 -H hosts --launcher ssh \
         python train.py --kv-store dist_sync
 
+    # serving fleet: router + 3 replicas (docs/serving.md "Fleet");
+    # serve.py switches on MXTPU_FLEET_ROLE
+    python tools/launch.py --serve-fleet 3 --max-restarts 2 \
+        python serve.py
+
 Launch modes:
     local (default) — N processes on this host (the reference's
         `--launcher local` used by tests/nightly/dist_sync_kvstore.py)
@@ -462,11 +467,228 @@ def _run_once(spawners, hb_files=None, hb_timeout=0,
                 p.kill()
 
 
+# ---------------------------------------------------------------------------
+# serving fleet mode (--serve-fleet, docs/serving.md "Fleet")
+#
+# One router + N replica workers on this host.  Unlike training
+# (collective: one dead rank wedges the world, so restart is
+# whole-job), a serving replica is independent — the router
+# re-dispatches its in-flight requests to survivors — so a dead or
+# hung replica is respawned *in place* while the fleet keeps serving.
+# The router process decides the job: exit 0 is success (the replicas
+# are then stopped), any other exit tears the fleet down.
+# ---------------------------------------------------------------------------
+
+def _fleet_env(args, role, rank, router_port, replica_ports):
+    """Env for one fleet member.  The same user command runs as every
+    member and switches on MXTPU_FLEET_ROLE (router | replica); the
+    wiring rides the other exports — ServingRouter defaults its
+    replica list from MXTPU_REPLICA_ADDRS and ReplicaServer its port
+    from MXTPU_REPLICA_PORT, so a role-switch script needs no CLI
+    plumbing of its own."""
+    env = {
+        "MXTPU_FLEET_ROLE": role,
+        "MXTPU_FLEET_REPLICAS": str(len(replica_ports)),
+        "MXTPU_ROUTER_PORT": str(router_port),
+        "MXTPU_REPLICA_ADDRS": ",".join(
+            f"127.0.0.1:{p}" for p in replica_ports),
+        "MXTPU_WORKER_RANK": str(rank),
+    }
+    if role == "replica":
+        env["MXTPU_REPLICA_PORT"] = str(replica_ports[rank])
+    for kv in args.env:
+        if "=" not in kv:
+            raise ValueError(f"--env wants KEY=VALUE, got {kv!r}")
+        k, v = kv.split("=", 1)
+        env[k] = v
+    return env
+
+
+def _fleet_status(snaps, healthy, n, rate_state):
+    """One fleet status line: replica health from process liveness +
+    heartbeat freshness, request rate from the delta of the fleet's
+    summed serving_requests_total between ticks."""
+    total = sum((s.get("counters") or {})
+                .get("serving_requests_total", 0)
+                for s in snaps.values())
+    now = time.time()
+    rate = 0.0
+    if rate_state["ts"] is not None and now > rate_state["ts"]:
+        rate = max(0, total - rate_state["total"]) \
+            / (now - rate_state["ts"])
+    rate_state["ts"], rate_state["total"] = now, total
+    parts = [f"fleet: {healthy}/{n} healthy, {rate:.1f} req/s"]
+    agg = _aggregate_telemetry(snaps)
+    if agg.get("serve_queue", 0) > 0:
+        parts.append(f"serve queue: {agg['serve_queue']} req "
+                     f"({agg['serve_queued_tokens']} tok)")
+    errs = [f"{nm}={agg['counters'][nm]}" for nm in _ERROR_COUNTERS
+            if agg["counters"].get(nm)]
+    if errs:
+        parts.append("errors: " + " ".join(errs))
+    return "launch.py: status: " + " | ".join(parts)
+
+
+def _run_fleet(args, cmd, hb_dir):
+    """--serve-fleet monitor loop: spawn router + N replicas, respawn
+    dead/hung replicas in place under the --max-restarts ledger,
+    follow the router's exit."""
+    n = args.serve_fleet
+    router_port = _free_port()
+    replica_ports = [_free_port() for _ in range(n)]
+    members = {}        # key -> {proc, hb, role, rank, killed}
+    gens = {}           # key -> spawn generation (fresh heartbeat
+                        # file per respawn: a replacement must not
+                        # inherit the dead replica's mtimes)
+
+    def spawn(role, rank):
+        key = "router" if role == "router" else f"replica-{rank}"
+        gen = gens.get(key, -1) + 1
+        gens[key] = gen
+        env = dict(os.environ)
+        env.update(_fleet_env(args, role, rank, router_port,
+                              replica_ports))
+        env["MXTPU_RESTART_ATTEMPT"] = str(gen)
+        env["MXTPU_WORLD_GENERATION"] = str(gen + 1)
+        hb = None
+        if hb_dir is not None:
+            hb = _hb_path(hb_dir, gen, key)
+            env["MXTPU_HEARTBEAT_FILE"] = hb
+            env["MXTPU_HEARTBEAT_INTERVAL"] = \
+                str(args.heartbeat_interval)
+        members[key] = {"proc": subprocess.Popen(cmd, env=env),
+                        "hb": hb, "role": role, "rank": rank,
+                        "killed": False}
+
+    def hb_fresh(m, now):
+        """Healthy = alive process + fresh (or not-yet-created)
+        heartbeat; a replica mid-dispatch with a stale beat is the
+        one the router's breaker is about to open on."""
+        if m["proc"].poll() is not None:
+            return False
+        if args.heartbeat_timeout <= 0 or m["hb"] is None:
+            return True
+        try:
+            age = now - os.path.getmtime(m["hb"])
+        except OSError:
+            return True     # no heartbeat yet: unmonitored
+        return age <= args.heartbeat_timeout
+
+    restarts = 0
+    rate_state = {"ts": None, "total": 0}
+    rc = 1
+    try:
+        spawn("router", 0)
+        for r in range(n):
+            spawn("replica", r)
+        next_status = time.time() + args.status_interval \
+            if args.status_interval > 0 and hb_dir is not None \
+            else None
+        done = False
+        while not done:
+            now = time.time()
+            # hung-member kill (same heartbeat-staleness rule as
+            # training workers): turns a wedged replica into an
+            # ordinary dead one the respawn path handles
+            for key, m in members.items():
+                p = m["proc"]
+                if p.poll() is None and args.heartbeat_timeout > 0 \
+                        and m["hb"] is not None and not m["killed"]:
+                    try:
+                        age = now - os.path.getmtime(m["hb"])
+                    except OSError:
+                        continue    # no heartbeat yet: unmonitored
+                    if age > args.heartbeat_timeout:
+                        print(f"launch.py: fleet member {key} hung "
+                              f"(no heartbeat for {age:.0f}s > "
+                              f"{args.heartbeat_timeout:.0f}s); "
+                              "killing it", file=sys.stderr)
+                        p.kill()
+                        m["killed"] = True
+            # the router's exit decides the job
+            code = members["router"]["proc"].poll()
+            if code is not None:
+                if code == 0:
+                    print("launch.py: router exited cleanly; "
+                          "stopping the replicas", file=sys.stderr)
+                    rc = 0
+                else:
+                    print(f"launch.py: router exited with {code}; "
+                          "terminating the fleet", file=sys.stderr)
+                    rc = code or 1
+                break
+            # dead replicas respawn in place under the restart ledger
+            for key, m in list(members.items()):
+                if m["role"] != "replica" or m.get("reaped"):
+                    continue
+                code = m["proc"].poll()
+                if code is None:
+                    continue
+                if code == 0 and not m["killed"]:
+                    # deliberate exit: a replica that drained (the
+                    # router's fleet drain, or its own SIGTERM
+                    # snapshot-then-drain) is done, not dead
+                    print(f"launch.py: replica {m['rank']} exited "
+                          "cleanly (drained); not respawning",
+                          file=sys.stderr)
+                    m["reaped"] = True
+                    continue
+                why = "hung (killed)" if m["killed"] \
+                    else f"exited with {code}"
+                if restarts >= args.max_restarts:
+                    print(f"launch.py: replica {m['rank']} {why}; "
+                          f"restart budget spent ({restarts}/"
+                          f"{args.max_restarts}); terminating the "
+                          "fleet", file=sys.stderr)
+                    rc = code or 1
+                    done = True
+                    break
+                restarts += 1
+                print(f"launch.py: replica {m['rank']} {why}; "
+                      f"respawning in place (restart {restarts}/"
+                      f"{args.max_restarts}); the router re-"
+                      "dispatches its in-flight requests meanwhile",
+                      file=sys.stderr)
+                spawn("replica", m["rank"])
+            if done:
+                break
+            if next_status is not None and now >= next_status:
+                next_status = now + args.status_interval
+                snaps = _collect_snapshots(
+                    {k: m["hb"] for k, m in members.items()
+                     if m["hb"] is not None})
+                healthy = sum(1 for m in members.values()
+                              if m["role"] == "replica"
+                              and hb_fresh(m, now))
+                print(_fleet_status(snaps, healthy, n, rate_state),
+                      file=sys.stderr)
+            time.sleep(0.05)
+        return rc
+    finally:
+        # SIGTERM = drain: the router snapshots + drains the fleet,
+        # replicas snapshot-then-drain their own engines
+        procs = [m["proc"] for m in members.values()]
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.time() + 10
+        for p in procs:
+            while p.poll() is None and time.time() < deadline:
+                time.sleep(0.05)
+            if p.poll() is None:
+                p.kill()
+        if hb_dir is not None:
+            print(_format_report(_collect_snapshots(
+                {k: m["hb"] for k, m in members.items()
+                 if m["hb"] is not None})), file=sys.stderr)
+
+
 def main():
     ap = argparse.ArgumentParser(
         description="Launch a distributed training job")
-    ap.add_argument("-n", "--num-workers", type=int, required=True,
-                    help="number of worker processes")
+    ap.add_argument("-n", "--num-workers", type=int, default=None,
+                    help="number of worker processes (required "
+                    "except with --serve-fleet)")
     ap.add_argument("-s", "--num-servers", type=int, default=0,
                     help="ignored (no parameter servers; kept for "
                     "CLI parity with the reference)")
@@ -545,6 +767,19 @@ def main():
                     "a fresh MXTPU_WORLD_GENERATION per world; "
                     "requires reshardable (sharded-manifest) "
                     "checkpoints to resume onto the changed world")
+    ap.add_argument("--serve-fleet", type=int, default=None,
+                    metavar="N",
+                    help="serving fleet mode (docs/serving.md "
+                    "\"Fleet\"): run the command N+1 times on this "
+                    "host — one router plus N replica workers — "
+                    "wired through MXTPU_FLEET_ROLE / "
+                    "MXTPU_ROUTER_PORT / MXTPU_REPLICA_ADDRS / "
+                    "MXTPU_REPLICA_PORT (the command switches on "
+                    "the role).  A dead or hung replica respawns in "
+                    "place under the --max-restarts ledger while the "
+                    "router re-dispatches its in-flight requests; "
+                    "the router's exit decides the job (0 stops the "
+                    "replicas and succeeds)")
     ap.add_argument("--max-elastic-restarts", type=int, default=3,
                     help="elastic restarts budget (counted and "
                     "logged separately from --max-restarts, which "
@@ -558,6 +793,9 @@ def main():
     cmd = args.command
     if cmd and cmd[0] == "--":
         cmd = cmd[1:]
+    if args.serve_fleet is None and args.num_workers is None:
+        ap.error("-n/--num-workers is required (except with "
+                 "--serve-fleet)")
 
     if 0 < args.heartbeat_timeout < 2 * args.heartbeat_interval:
         # a worker sleeping one interval must never look hung — the
@@ -576,6 +814,17 @@ def main():
         # docs/resilience.md)
         import tempfile
         hb_dir = tempfile.mkdtemp(prefix="mxtpu_hb_")
+
+    if args.serve_fleet is not None:
+        if args.launcher != "local":
+            ap.error("--serve-fleet requires --launcher local")
+        if args.serve_fleet < 1:
+            ap.error("--serve-fleet wants N >= 1 replicas")
+        try:
+            return _run_fleet(args, cmd, hb_dir)
+        finally:
+            if hb_dir is not None:
+                shutil.rmtree(hb_dir, ignore_errors=True)
 
     if args.launcher == "local":
         def make_spawners(coord, attempt, world):
