@@ -1635,6 +1635,215 @@ def _bench_data_service(dev, platform):
     }))
 
 
+def _bench_data_service_net(dev, platform):
+    """Remote data-service ranks (docs/data_service.md "Remote
+    ranks"): loopback-remote vs local-shm shard throughput and
+    per-batch overhead of the framed-RPC + base64 transport, mixed-
+    placement bit-identity vs all-local, SIGKILL-host failover
+    recovery timing with the epoch still bit-identical, and a
+    no-leak audit (shm segments).  Run with
+    MXTPU_BENCH_MODEL=data_service_net; writes BENCH_r17.json.
+
+    Loopback is the honest worst case for transport overhead: real
+    deployments hide the wire cost behind the credit window, but
+    both placements here decode on the SAME host, so any rate gap
+    IS the serialization + framing tax."""
+    import signal
+    import tempfile
+
+    from incubator_mxnet_tpu.data_service import DataServiceIter
+    from incubator_mxnet_tpu.data_service.net import RemoteShardServer
+
+    ncores = os.cpu_count() or 1
+    n_img = int(os.environ.get("MXTPU_BENCH_DSN_IMGS", "512"))
+    reps = int(os.environ.get("MXTPU_BENCH_DSN_REPS", "3"))
+    shape = (3, 224, 224)
+    W = 2
+
+    with tempfile.TemporaryDirectory() as td:
+        prefix = os.path.join(td, "synth")
+        _stage(f"generating {n_img} JPEGs", "dsn")
+        _make_synthetic_rec(prefix, n_img)
+
+        srv = RemoteShardServer(host="127.0.0.1", port=0,
+                                max_shards=W).start()
+        addr = f"127.0.0.1:{srv.port}"
+
+        def run_epoch(remote_addrs):
+            svc = DataServiceIter(
+                path_imgrec=prefix + ".rec", data_shape=shape,
+                batch_size=BATCH, num_workers=W,
+                preprocess_threads=1, round_batch=True,
+                remote_addrs=remote_addrs)
+            try:
+                sum(1 for _ in svc)     # warm epoch (spawn/connect)
+                svc.reset()
+                t0 = time.perf_counter()
+                n = sum(b.data[0].shape[0] - b.pad for b in svc)
+                dt = time.perf_counter() - t0
+                return n / dt, dt
+            finally:
+                svc.close()
+
+        placements = {"local": [], "mixed": [addr],
+                      "all_remote": [addr] * W}
+        samples = {k: [] for k in placements}
+        n_batches = (n_img + BATCH - 1) // BATCH
+        for r in range(reps):
+            _stage(f"measurement round {r + 1}/{reps}", "dsn")
+            for k, addrs in placements.items():
+                samples[k].append(run_epoch(addrs))
+
+        def med_rate(k):
+            return float(np.median([s[0] for s in samples[k]]))
+
+        def best_rate(k):
+            return max(s[0] for s in samples[k])
+
+        def med_epoch_s(k):
+            return float(np.median([s[1] for s in samples[k]]))
+
+        # ---- bit-identity: mixed placement vs all-local ----------
+        _stage("bit-identity mixed vs local", "dsn")
+
+        def epoch_batches(remote_addrs):
+            with DataServiceIter(
+                    path_imgrec=prefix + ".rec", data_shape=shape,
+                    batch_size=BATCH, num_workers=W,
+                    preprocess_threads=1, round_batch=True,
+                    remote_addrs=remote_addrs) as svc:
+                return [(b.data[0].asnumpy(), b.label[0].asnumpy(),
+                         b.pad) for b in svc]
+
+        ref = epoch_batches([])
+        got = epoch_batches([addr])
+        bit_identical = len(got) == len(ref) and all(
+            p == rp and np.array_equal(d, rd)
+            and np.array_equal(l, rl)
+            for (d, l, p), (rd, rl, rp) in zip(got, ref))
+        srv.close()
+
+        # ---- SIGKILL-host failover: recovery time + exactness ----
+        _stage("host-kill failover", "dsn")
+        import subprocess as _sp
+        import warnings as _warnings
+        pf = os.path.join(td, "port")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   MXTPU_FAULT_SPEC="data_service:host:3:kill")
+        env.setdefault("PYTHONPATH", os.path.dirname(
+            os.path.abspath(__file__)))
+        proc = _sp.Popen(
+            [sys.executable, "-m",
+             "incubator_mxnet_tpu.data_service.net",
+             "--port-file", pf, "--shards", "1"], env=env)
+        deadline = time.monotonic() + 30
+        while not os.path.exists(pf) and time.monotonic() < deadline:
+            time.sleep(0.05)
+        port = int(open(pf).read())
+        os.environ["MXTPU_DATA_HOST_GRACE"] = "3"
+        try:
+            with DataServiceIter(
+                    path_imgrec=prefix + ".rec", data_shape=shape,
+                    batch_size=BATCH, num_workers=W,
+                    preprocess_threads=1, round_batch=True,
+                    remote_addrs=[f"127.0.0.1:{port}"]) as svc:
+                got = []
+                kill_recovery_s = None
+                with _warnings.catch_warnings():
+                    _warnings.simplefilter("ignore")
+                    try:
+                        while True:
+                            t0 = time.perf_counter()
+                            b = svc.next()
+                            dt = time.perf_counter() - t0
+                            if kill_recovery_s is None \
+                                    and svc._restarts:
+                                kill_recovery_s = dt
+                            got.append((b.data[0].asnumpy(),
+                                        b.label[0].asnumpy(), b.pad))
+                    except StopIteration:
+                        pass
+                st = svc.stats()
+                kill_identical = len(got) == len(ref) and all(
+                    p == rp and np.array_equal(d, rd)
+                    for (d, _, p), (rd, _, rp) in zip(got, ref))
+                demoted_to_local = st["remote_shards"] == 0
+                restarts = st["restarts"]
+        finally:
+            os.environ.pop("MXTPU_DATA_HOST_GRACE", None)
+            if proc.poll() is None:
+                proc.kill()
+            proc.wait(timeout=10)
+        # the resource tracker unlinks the killed host's ring
+        # asynchronously — poll before auditing
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and any(
+                f.startswith("mxtpu_ds")
+                for f in os.listdir("/dev/shm")):
+            time.sleep(0.1)
+        shm_clean = not [f for f in os.listdir("/dev/shm")
+                         if f.startswith("mxtpu_ds")]
+
+    # per-batch transport tax: epoch wall-clock delta amortized over
+    # the batches the REMOTE shard carried (~1/W of the epoch)
+    remote_batches = max(n_batches // W, 1)
+    tax_ms = (med_epoch_s("mixed") - med_epoch_s("local")) \
+        / remote_batches * 1e3
+    artifact = {
+        "metric": "data_service_net_loopback_throughput",
+        "platform": platform,
+        "host": {"ncores": ncores, "n_images": n_img,
+                 "batch": BATCH, "rounds": reps, "workers": W,
+                 "note": ("loopback remote ranks decode on the SAME "
+                          "host as the consumer: the rate gap vs "
+                          "all-local IS the framed-RPC + base64 "
+                          "serialization tax, with no extra cores "
+                          "to pay for it — real multi-host fleets "
+                          "add decode cores instead")},
+        "throughput_img_s": {
+            k: {"median": round(med_rate(k), 1),
+                "best": round(best_rate(k), 1)}
+            for k in placements},
+        "transport": {
+            "mixed_vs_local_ratio": round(
+                med_rate("mixed") / med_rate("local"), 3),
+            "all_remote_vs_local_ratio": round(
+                med_rate("all_remote") / med_rate("local"), 3),
+            "per_remote_batch_overhead_ms": round(tax_ms, 2),
+        },
+        "correctness": {
+            "mixed_bit_identical": bit_identical,
+            "host_kill_epoch_bit_identical": kill_identical,
+            "host_kill_recovery_s": round(kill_recovery_s, 2)
+            if kill_recovery_s is not None else None,
+            "host_kill_demoted_to_local": demoted_to_local,
+            "restarts": restarts,
+            "no_orphan_shm": shm_clean,
+        },
+        "acceptance": {
+            "bit_identical_all_placements": bool(
+                bit_identical and kill_identical),
+            "failover_no_lost_batches": kill_identical,
+            "no_leaks": shm_clean,
+        },
+    }
+    out = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_r17.json")
+    with open(out, "w") as f:
+        f.write(json.dumps(artifact, indent=2) + "\n")
+    print(json.dumps({
+        "metric": "data_service_net_loopback_throughput",
+        "mixed_img_s": round(med_rate("mixed"), 1),
+        "local_img_s": round(med_rate("local"), 1),
+        "per_remote_batch_overhead_ms": round(tax_ms, 2),
+        "bit_identical": bit_identical,
+        "kill_recovery_s": round(kill_recovery_s, 2)
+        if kill_recovery_s is not None else None,
+        "platform": platform,
+        "artifact": "BENCH_r17.json",
+    }))
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -1672,6 +1881,9 @@ def main():
         return
     if os.environ.get("MXTPU_BENCH_MODEL") == "data_service":
         _bench_data_service(dev, platform)
+        return
+    if os.environ.get("MXTPU_BENCH_MODEL") == "data_service_net":
+        _bench_data_service_net(dev, platform)
         return
 
     import incubator_mxnet_tpu as mx

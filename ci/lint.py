@@ -113,9 +113,13 @@ SYNC_ROOT_ATTRS = {("np", "asarray"), ("numpy", "asarray"),
 # exception carries `# deadline-ok: <why>` on the line or in the
 # comment block directly above it.
 SOCKET_WAIT_FILES = (
+    "incubator_mxnet_tpu/rpc.py",
     "incubator_mxnet_tpu/serving/rpc.py",
     "incubator_mxnet_tpu/serving/router.py",
     "incubator_mxnet_tpu/serving/replica.py",
+    # remote data-service ranks: a dead train host must never park a
+    # shard server's stream thread (and vice versa)
+    "incubator_mxnet_tpu/data_service/net.py",
 )
 SOCKET_WAIT_ATTRS = {"recv", "accept", "connect",
                      "create_connection"}
@@ -132,6 +136,10 @@ SOCKET_WAIT_ATTRS = {"recv", "accept", "connect",
 MONO_CLOCK_PATHS = (
     "incubator_mxnet_tpu/serving/",
     "incubator_mxnet_tpu/resilience.py",
+    # the shared RPC transport and the remote data-plane ranks do
+    # deadline arithmetic too (moved out of serving/, keep covered)
+    "incubator_mxnet_tpu/rpc.py",
+    "incubator_mxnet_tpu/data_service/net.py",
 )
 
 # MXTPU_-prefixed tokens that are NOT environment variables (log

@@ -3,10 +3,15 @@
 ``DataServiceIter`` shards a RecordIO dataset across N decode worker
 processes (native ``src/imgdec`` decoder, own thread pools) and
 streams finished batches through bounded shared-memory rings — the
-answer to PERF.md's measured single-process input ceiling.
+answer to PERF.md's measured single-process input ceiling.  With
+``remote_addrs`` / ``MXTPU_DATA_REMOTE_ADDRS`` some shards decode on
+OTHER hosts (``net.py``'s ``RemoteShardServer``) and stream batches
+back over the framed RPC — same merge, bit-identical order.
 """
+from .net import RemoteShard, RemoteShardDown, RemoteShardServer
 from .ring import ShmBatchRing
 from .service import DataServiceIter
 from .worker import build_decode_spec
 
-__all__ = ["DataServiceIter", "ShmBatchRing", "build_decode_spec"]
+__all__ = ["DataServiceIter", "RemoteShard", "RemoteShardDown",
+           "RemoteShardServer", "ShmBatchRing", "build_decode_spec"]

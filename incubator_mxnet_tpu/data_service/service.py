@@ -37,6 +37,15 @@ Contracts:
   (`data_service_worker_dead`/`data_service_worker_restart`); every
   shard's corrupt-record quarantine rolls up into the ONE global
   ``MXTPU_MAX_BAD_RECORDS`` budget.
+- **Remote ranks** — ``remote_addrs`` / ``MXTPU_DATA_REMOTE_ADDRS``
+  re-homes the LAST ``len(addrs)`` shards onto remote decode hosts
+  (``data_service/net.py``): same worker code, same epoch commands,
+  batches stream back as CRC-framed RPC frames instead of shm slots,
+  and the round-robin merge cannot tell the transports apart — the
+  delivered stream stays bit-identical to all-local.  A poisoned
+  link or dead host re-homes its shard (reconnect, else a local
+  respawn at the same cursors) under the same restart budget
+  (docs/data_service.md "Remote ranks").
 
 Workers are persistent (one fork per shard for the service lifetime):
 a clean epoch boundary is one small command down each control pipe —
@@ -58,6 +67,7 @@ from ..ndarray.ndarray import array as nd_array
 from ..resilience import DataPipelineError, data_timeout, inject
 from ..tracing import trace_event
 from ..utils.env import get_env
+from . import net as _net
 from . import ring as _ring
 from .worker import build_decode_spec, worker_main
 
@@ -79,7 +89,7 @@ class DataServiceIter(DataIter):
                  mean_g=0, mean_b=0, std_r=0, std_g=0, std_b=0,
                  resize=0, preprocess_threads=1, ring_depth=None,
                  round_batch=True, data_name="data",
-                 label_name="softmax_label"):
+                 label_name="softmax_label", remote_addrs=None):
         super().__init__(batch_size)
         self._W = int(num_workers if num_workers is not None
                       else get_env("MXTPU_DATA_WORKERS"))
@@ -130,6 +140,7 @@ class DataServiceIter(DataIter):
         self._procs = [None] * self._W
         self._conns = [None] * self._W
         self._rings = []
+        self._remotes = {}
         self._closed = False
         self._resume_pending = False
         self._resume_state = None
@@ -137,11 +148,37 @@ class DataServiceIter(DataIter):
         self._bad_total = 0
         self._shard_bad = [0] * self._W
         self._shard_done = [True] * self._W   # "pre-epoch": clean
+        self._depth = depth
+        credits = int(get_env("MXTPU_DATA_NET_CREDITS"))
+        self._net_credits = credits if credits > 0 else depth
+        if remote_addrs is None:
+            raw = get_env("MXTPU_DATA_REMOTE_ADDRS")
+            remote_addrs = [a.strip() for a in raw.split(",")
+                            if a.strip()] if raw else []
+        addrs = list(remote_addrs)
+        if len(addrs) > self._W:
+            warnings.warn(
+                f"DataServiceIter: {len(addrs)} remote addr(s) for "
+                f"{self._W} shard(s); the last "
+                f"{len(addrs) - self._W} go unused", RuntimeWarning)
+            addrs = addrs[:self._W]
+        # every shard still gets a LOCAL ring, even remote ones:
+        # it is the failover demote target when a remote host dies
+        # for good (shards re-home at their exact cursors)
         for w in range(self._W):
             self._rings.append(
                 _ring.ShmBatchRing(batch_size, self.data_shape,
                                    self.label_width, depth, self._ctx,
                                    tag=f"_s{w}"))
+        # placement: the LAST len(addrs) shards stream over sockets
+        # (shard identity, cursors, and the merge order never depend
+        # on placement — that is the bit-identity argument)
+        first_remote = self._W - len(addrs)
+        for i, addr in enumerate(addrs):
+            w = first_remote + i
+            self._remotes[w] = _net.RemoteShard(
+                w, addr, batch_size, self.data_shape,
+                self.label_width)
         self.reset()
 
     # ------------------------------------------------------------ epoch
@@ -196,7 +233,7 @@ class DataServiceIter(DataIter):
             self._seed_base = int(st.get("seed_base", 0))
             for w in range(self._W):
                 if not self._shard_done[w]:
-                    self._spawn_shard(w)
+                    self._start_shard(w)
             # a resharded-under-quarantine position resumes by exact
             # replay: deliver-and-discard to the recorded global
             # batch (ImageRecordIter's replay-discard semantics —
@@ -217,11 +254,7 @@ class DataServiceIter(DataIter):
         self._epoch_init()
         self._pick_seed_base()
         for w in range(self._W):
-            if self._procs[w] is not None \
-                    and self._procs[w].is_alive():
-                self._conns[w].send(self._epoch_cmd(w))
-            else:
-                self._spawn_shard(w)
+            self._start_shard(w)
 
     def _pick_seed_base(self):
         # mirror draws must not touch the global RNG stream unless
@@ -229,12 +262,13 @@ class DataServiceIter(DataIter):
         self._seed_base = int(np.random.randint(1 << 31)) \
             if self._rand_mirror else 0
 
-    def _spawn_shard(self, w):
-        """(Re)spawn shard ``w``'s worker and hand it the current
-        epoch command at the shard's current cursors."""
-        if self._procs[w] is not None:
-            self._reap_shard(w)
-        static_spec = {
+    def _static_spec(self, w):
+        """The worker spec for shard ``w`` — identical whether the
+        worker forks locally or runs on a remote host (the remote
+        server adds nothing and removes nothing: that is half of the
+        bit-identity argument; the other half is the epoch command's
+        global-batch-keyed seeding)."""
+        return {
             "path_imgrec": self._path,
             "idx_path": self._idx_path,
             "shard": w,
@@ -243,7 +277,79 @@ class DataServiceIter(DataIter):
             "label_width": self.label_width,
             "round_batch": self.round_batch,
             "decode": self._decode,
+            "ring_depth": self._depth,
         }
+
+    def _start_shard(self, w):
+        """Start (or command) shard ``w``'s next epoch on whatever
+        transport currently homes it."""
+        rs = self._remotes.get(w)
+        if rs is None:
+            if self._procs[w] is not None \
+                    and self._procs[w].is_alive():
+                self._conns[w].send(self._epoch_cmd(w))
+            else:
+                self._spawn_shard(w)
+            return
+        try:
+            rs.start_epoch(self._static_spec(w), self._epoch_cmd(w),
+                           self._net_credits)
+        except _net.RemoteShardDown as e:
+            self._remote_failover(w, e)
+
+    def _remote_failover(self, w, why):
+        """Re-home remote shard ``w`` after its link poisoned or its
+        host went silent: one budgeted attempt against the same host
+        on a fresh connection, else demote to a local worker — either
+        way the shard restarts at its exact last-delivered cursors,
+        so the merged stream continues bit-identically."""
+        rs = self._remotes[w]
+        source = f"DataServiceIter({self._path}) shard {w}"
+        trace_event("data_service_host_down", shard=w, addr=rs.addr,
+                    why=str(why),
+                    delivered=self._shard_delivered[w],
+                    consumed=self._shard_consumed[w])
+        budget = get_env("MXTPU_DATA_WORKER_RESTARTS")
+        if self._restarts >= budget:
+            raise DataPipelineError(
+                f"{source}: remote host {rs.addr} down ({why}) and "
+                f"the restart budget is spent (restarted "
+                f"{self._restarts} time(s), "
+                f"MXTPU_DATA_WORKER_RESTARTS={budget}); check the "
+                "remote host and MXTPU_DATA_REMOTE_ADDRS") from None
+        self._restarts += 1
+        telemetry.counter("data_service_net_restarts_total").inc()
+        if rs.try_restart(self._static_spec(w), self._epoch_cmd(w),
+                          self._net_credits):
+            trace_event("data_service_failover", shard=w,
+                        target="remote", addr=rs.addr,
+                        restart=self._restarts, budget=budget)
+            warnings.warn(
+                f"{source}: link to {rs.addr} poisoned ({why}); "
+                f"reconnected and resumed from batch "
+                f"{self._shard_delivered[w]} (restart "
+                f"{self._restarts}/{budget})", RuntimeWarning)
+            return
+        # the host is really gone: re-home onto a local worker (its
+        # ring was provisioned at construction for exactly this)
+        rs.close()
+        del self._remotes[w]
+        trace_event("data_service_failover", shard=w,
+                    target="local", addr=rs.addr,
+                    restart=self._restarts, budget=budget)
+        warnings.warn(
+            f"{source}: remote host {rs.addr} is down ({why}); "
+            f"re-homing the shard to a local worker from batch "
+            f"{self._shard_delivered[w]} (restart "
+            f"{self._restarts}/{budget})", RuntimeWarning)
+        self._spawn_shard(w)
+
+    def _spawn_shard(self, w):
+        """(Re)spawn shard ``w``'s local worker and hand it the
+        current epoch command at the shard's current cursors."""
+        if self._procs[w] is not None:
+            self._reap_shard(w)
+        static_spec = self._static_spec(w)
         self._rings[w].reset_sync()
         parent_conn, child_conn = self._ctx.Pipe()
         proc = self._ctx.Process(
@@ -279,6 +385,8 @@ class DataServiceIter(DataIter):
     def _halt_workers(self):
         for w in range(self._W):
             self._reap_shard(w)
+        for rs in self._remotes.values():
+            rs.stop_stream()
 
     # ------------------------------------------------- resumable state
     def state_dict(self):
@@ -412,11 +520,23 @@ class DataServiceIter(DataIter):
                 "to tolerate more, or repair the dataset")
 
     def _get_from_shard(self, w):
-        """One ring take with supervision: a dead worker is respawned
-        from its last-delivered cursor under the restart budget."""
+        """One take with supervision: a dead local worker is
+        respawned, a down remote host re-homed (same host on a fresh
+        link, else a local worker) — always from the shard's
+        last-delivered cursor, under the one restart budget."""
         inject("data_service", "ring")
         source = f"DataServiceIter({self._path}) shard {w}"
         while True:
+            rs = self._remotes.get(w)
+            if rs is not None:
+                try:
+                    return rs.get(source, data_timeout())
+                except _net.RemoteShardDown as e:
+                    # after failover the shard is either back on its
+                    # remote (loop retries the socket) or demoted
+                    # (loop falls through to the local ring)
+                    self._remote_failover(w, e)
+                    continue
             proc = self._procs[w]
             alive = proc.is_alive if proc is not None \
                 else (lambda: False)
@@ -498,6 +618,11 @@ class DataServiceIter(DataIter):
             telemetry.gauge(
                 "data_service_shard%d_img_per_sec" % w).set(
                 self._shard_imgs[w] / dt)
+            if self._remotes:
+                telemetry.gauge(
+                    "data_service_remote_img_per_sec").set(
+                    sum(self._shard_imgs[r]
+                        for r in self._remotes) / dt)
         telemetry.gauge("data_service_ring_depth").set(
             sum(r.filled_depth() for r in self._rings))
 
@@ -523,13 +648,16 @@ class DataServiceIter(DataIter):
             "img_per_sec": self._epoch_imgs / dt,
             "restarts": self._restarts,
             "bad_records": self._bad_total,
+            "remote_shards": len(self._remotes),
             "shards": {
                 w: {"img_per_sec": self._shard_imgs[w] / dt,
                     "delivered": self._shard_delivered[w],
                     "consumed": self._shard_consumed[w],
                     "ring_depth": self._rings[w].filled_depth(),
                     "bad_records": self._shard_bad[w],
-                    "done": self._shard_done[w]}
+                    "done": self._shard_done[w],
+                    "remote": self._remotes[w].addr
+                    if w in self._remotes else None}
                 for w in range(self._W)},
         }
 
@@ -543,6 +671,9 @@ class DataServiceIter(DataIter):
         try:
             self._halt_workers()
         finally:
+            for rs in self._remotes.values():
+                rs.close()
+            self._remotes = {}
             for r in self._rings:
                 r.close()
 

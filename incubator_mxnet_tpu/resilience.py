@@ -448,14 +448,15 @@ def parse_fault_spec(raw):
                 f"bad fault spec {entry!r}: kind 'truncate' only "
                 "applies to the 'checkpoint' and 'record' scopes")
         if kind == "corrupt" and \
-                scope not in ("checkpoint", "record", "router"):
+                scope not in ("checkpoint", "record", "router",
+                              "data_service"):
             # corrupt additionally applies where frame bytes flow:
-            # router:net garbles one payload byte after the CRC is
-            # computed (serving/rpc.py send path)
+            # router:net / data_service:net garble one payload byte
+            # after the CRC is computed (rpc.py send path)
             raise ValueError(
                 f"bad fault spec {entry!r}: kind 'corrupt' only "
-                "applies to the 'checkpoint', 'record' and "
-                "'router' scopes")
+                "applies to the 'checkpoint', 'record', 'router' "
+                "and 'data_service' scopes")
         if kind in ("nan", "inf") and scope not in ("grad", "loss"):
             raise ValueError(
                 f"bad fault spec {entry!r}: kind {kind!r} only "
@@ -464,16 +465,19 @@ def parse_fault_spec(raw):
             raise ValueError(
                 f"bad fault spec {entry!r}: kind 'spike' only "
                 "applies to the 'loss' scope")
-        if kind == "kill" and scope not in ("elastic", "router"):
+        if kind == "kill" and scope not in ("elastic", "router",
+                                            "data_service"):
             # hard process death is a cross-process layer's test
             # vector (a rank dying mid-step for the elastic restart
             # loop, a replica dying mid-dispatch for the router's
-            # failover re-dispatch); accepting it on scopes with
-            # in-process recovery semantics would just kill the test
-            # harness
+            # failover re-dispatch, a remote shard host dying
+            # mid-stream for the data plane's failover re-home);
+            # accepting it on scopes with in-process recovery
+            # semantics would just kill the test harness
             raise ValueError(
                 f"bad fault spec {entry!r}: kind 'kill' only "
-                "applies to the 'elastic' and 'router' scopes")
+                "applies to the 'elastic', 'router' and "
+                "'data_service' scopes")
         if nth != "*":
             try:
                 nth = int(nth)

@@ -329,6 +329,24 @@ register_env("MXTPU_DATA_RING_DEPTH", int, 4,
              "shared-memory ring; backpressure blocks the worker — "
              "never grows memory — once the ring is full (host "
              "memory is num_workers * depth * batch_bytes)")
+register_env("MXTPU_DATA_REMOTE_ADDRS", str, "",
+             "comma-separated host:port list of RemoteShardServer "
+             "ranks (data_service/net.py); when set (or "
+             "remote_addrs= is passed) the LAST len(addrs) shards "
+             "of a DataServiceIter stream over sockets instead of "
+             "local shm rings — same merge order, bit-identical "
+             "batches; tools/launch.py --data-hosts exports it")
+register_env("MXTPU_DATA_NET_CREDITS", int, 0,
+             "in-flight batch frames a remote data-service shard "
+             "may send ahead of consumption (credit-based "
+             "backpressure mirroring the shm ring's semaphore "
+             "contract); 0 (default) uses the shard's ring depth")
+register_env("MXTPU_DATA_HOST_GRACE", float, 10.0,
+             "seconds a train host tolerates total silence (no "
+             "batch, heartbeat, or pong frames) from a remote "
+             "data-service host before declaring it dead and "
+             "failing its shards over (docs/data_service.md "
+             "\"Remote ranks\")")
 register_env("MXTPU_DEVICE_PREFETCH_DEPTH", int, 2,
              "in-flight device batches a DevicePrefetchIter stages "
              "when its depth argument is not given (HBM use is "

@@ -1,0 +1,431 @@
+"""Remote data-service ranks (docs/data_service.md "Remote ranks"):
+shared-transport façade, loopback mixed-placement bit-identity vs
+all-local, credit-based backpressure bound, garbled-frame link
+poisoning, SIGKILL-host failover chaos with no leaked shm/sockets,
+state_dict placement independence, fault-grammar units, and the
+lint/launch plumbing."""
+import io as _pyio
+import os
+import pickle
+import socket
+import subprocess
+import sys
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+from incubator_mxnet_tpu import recordio as rio
+from incubator_mxnet_tpu import resilience as rz
+from incubator_mxnet_tpu import rpc as mx_rpc
+from incubator_mxnet_tpu.data_service import DataServiceIter
+from incubator_mxnet_tpu.data_service.net import (RemoteShard,
+                                                  RemoteShardDown,
+                                                  RemoteShardServer)
+from incubator_mxnet_tpu.data_service.worker import build_decode_spec
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SHAPE = (3, 48, 48)
+B = 8
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv("MXTPU_FAULT_SPEC", raising=False)
+    monkeypatch.delenv("MXTPU_DATA_REMOTE_ADDRS", raising=False)
+    rz.reset_faults()
+    yield
+    rz.reset_faults()
+
+
+def _make_jpeg_rec(prefix, n, edge=64):
+    from PIL import Image
+    rec = rio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    rs = np.random.RandomState(3)
+    for i in range(n):
+        gx = np.linspace(0, 255, edge, dtype=np.float32)
+        img = (gx[None, :, None] * 0.4 + gx[:, None, None] * 0.4
+               + rs.rand(edge, edge, 3) * 50).astype(np.uint8)
+        buf = _pyio.BytesIO()
+        Image.fromarray(img).save(buf, format="JPEG", quality=85)
+        rec.write_idx(i, rio.pack(
+            rio.IRHeader(0, float(i % 7), i, 0), buf.getvalue()))
+    rec.close()
+    return prefix
+
+
+@pytest.fixture(scope="module")
+def rec48(tmp_path_factory):
+    td = tmp_path_factory.mktemp("dsn48")
+    return _make_jpeg_rec(str(td / "ds"), 48)
+
+
+@pytest.fixture(scope="module")
+def rec44(tmp_path_factory):
+    """Partial tail batch (pad 4 under round_batch)."""
+    td = tmp_path_factory.mktemp("dsn44")
+    return _make_jpeg_rec(str(td / "ds"), 44)
+
+
+def _service(prefix, W, **kw):
+    kw.setdefault("preprocess_threads", 2)
+    return DataServiceIter(
+        path_imgrec=prefix + ".rec", data_shape=SHAPE, batch_size=B,
+        num_workers=W, round_batch=True, **kw)
+
+
+def _np_batches(it):
+    out = []
+    for b in it:
+        out.append((b.data[0].asnumpy().copy(),
+                    b.label[0].asnumpy().copy(), b.pad))
+    return out
+
+
+def _assert_same(got, ref, what=""):
+    assert len(got) == len(ref), (what, len(got), len(ref))
+    for i, ((d, l, p), (rd, rl, rp)) in enumerate(zip(got, ref)):
+        assert p == rp, (what, i, p, rp)
+        assert np.array_equal(d, rd), f"{what}: batch {i} data differs"
+        assert np.array_equal(l, rl), f"{what}: batch {i} label differs"
+
+
+def _shm_orphans():
+    return [f for f in os.listdir("/dev/shm")
+            if f.startswith("mxtpu_ds")]
+
+
+def _wait_shm_clean(deadline_s=10.0):
+    """The resource tracker unlinks a SIGKILLed server's segments
+    asynchronously — poll with a deadline instead of asserting an
+    instant."""
+    deadline = time.monotonic() + deadline_s
+    while _shm_orphans() and time.monotonic() < deadline:
+        time.sleep(0.1)
+    return _shm_orphans()
+
+
+@pytest.fixture
+def loopback(rec48):
+    """In-process RemoteShardServer on an ephemeral loopback port."""
+    srv = RemoteShardServer(host="127.0.0.1", port=0,
+                            max_shards=2).start()
+    yield f"127.0.0.1:{srv.port}"
+    srv.close()
+
+
+# ----------------------------------------------- shared RPC façade
+def test_serving_rpc_is_a_facade_over_shared_transport():
+    from incubator_mxnet_tpu.serving import rpc as srv_rpc
+    for name in ("RpcClient", "RpcServer", "RpcError",
+                 "RpcFrameError", "RpcTimeoutError", "send_frame",
+                 "recv_frame", "encode_frame", "default_timeout",
+                 "MAGIC", "MAX_FRAME_BYTES"):
+        assert getattr(srv_rpc, name) is getattr(mx_rpc, name), name
+    # serving default scope is unchanged by the extraction
+    assert mx_rpc.DEFAULT_FAULT_SCOPE == ("router", "net")
+
+
+def test_send_frame_fault_scope_none_bypasses_injection(monkeypatch):
+    monkeypatch.setenv("MXTPU_FAULT_SPEC", "router:net:*:error")
+    rz.reset_faults()
+    a, b = socket.socketpair()
+    try:
+        # scope None: the spec must not fire (control-frame path)
+        mx_rpc.send_frame(a, {"op": "x"}, fault_scope=None)
+        msg, _ = mx_rpc.recv_frame(b, timeout=2.0)
+        assert msg == {"op": "x"}
+        with pytest.raises(mx_rpc.RpcError):
+            mx_rpc.send_frame(a, {"op": "y"})   # default scope fires
+    finally:
+        a.close()
+        b.close()
+
+
+# ------------------------------------------------ loopback identity
+def test_mixed_remote_bit_identical_two_epochs(rec48, loopback):
+    with _service(rec48, 3) as local:
+        ref = _np_batches(local)
+        local.reset()
+        ref += _np_batches(local)
+    with _service(rec48, 3, remote_addrs=[loopback]) as mixed:
+        got = _np_batches(mixed)
+        mixed.reset()
+        got += _np_batches(mixed)
+        st = mixed.stats()
+        assert st["remote_shards"] == 1
+        assert st["shards"][2]["remote"] == loopback
+        assert st["shards"][0]["remote"] is None
+        assert st["restarts"] == 0
+    assert len(ref) == 12
+    _assert_same(got, ref, "mixed vs local, 2 epochs")
+    assert not _wait_shm_clean()
+
+
+def test_remote_pad_tail_bit_identical(rec44, loopback):
+    with _service(rec44, 3) as local:
+        ref = _np_batches(local)
+    with _service(rec44, 3, remote_addrs=[loopback]) as mixed:
+        got = _np_batches(mixed)
+    assert ref[-1][2] == 4      # pad rides the wire header verbatim
+    _assert_same(got, ref, "pad tail")
+
+
+def test_all_remote_via_env_var(rec48, loopback, monkeypatch):
+    """MXTPU_DATA_REMOTE_ADDRS homes EVERY shard remotely (two
+    streams on one server) with the same delivered stream."""
+    with _service(rec48, 2) as local:
+        ref = _np_batches(local)
+    monkeypatch.setenv("MXTPU_DATA_REMOTE_ADDRS",
+                       f"{loopback},{loopback}")
+    with _service(rec48, 2) as svc:
+        got = _np_batches(svc)
+        assert svc.stats()["remote_shards"] == 2
+    _assert_same(got, ref, "all-remote vs all-local")
+
+
+def test_state_dict_roundtrip_mixed_to_local(rec48, loopback):
+    """A position saved under mixed placement restores into an
+    all-local service: state is placement-independent."""
+    with _service(rec48, 3) as local:
+        ref = _np_batches(local)
+    with _service(rec48, 3, remote_addrs=[loopback]) as mixed:
+        head = [next(mixed) for _ in range(2)]
+        for b, (rd, rl, rp) in zip(head, ref[:2]):
+            assert np.array_equal(b.data[0].asnumpy(), rd)
+        state = pickle.loads(pickle.dumps(mixed.state_dict()))
+    with _service(rec48, 3) as svc2:
+        svc2.load_state_dict(state)
+        svc2.reset()
+        tail = _np_batches(svc2)
+    _assert_same(tail, ref[2:], "resume mixed -> all-local")
+
+
+# ----------------------------------------------------- backpressure
+def _epoch_msg(prefix, credits, shard=0, num_shards=1):
+    order = list(range(48))
+    return {"op": "epoch", "shard": shard, "stream": 1,
+            "credits": credits,
+            "static": {"path_imgrec": prefix + ".rec",
+                       "idx_path": prefix + ".idx",
+                       "shard": shard, "num_shards": num_shards,
+                       "batch_size": B, "label_width": 1,
+                       "round_batch": True,
+                       "decode": build_decode_spec(SHAPE),
+                       "ring_depth": 4},
+            "cmd": {"order": order, "num_batches": 6,
+                    "start_event": 0, "start_batch": 0,
+                    "start_bad": 0, "seed": 0}}
+
+
+def _drain_frames(cli, want, wait_s):
+    """Collect batch frames until ``want`` arrive or ``wait_s``
+    passes; heartbeats/pongs don't count."""
+    got = []
+    deadline = time.monotonic() + wait_s
+    while len(got) < want and time.monotonic() < deadline:
+        try:
+            msg, _ = cli.recv(timeout=0.2)
+        except mx_rpc.RpcTimeoutError:
+            continue
+        if msg.get("op") == "batch":
+            got.append(msg)
+    return got
+
+
+def test_credit_backpressure_bounds_inflight_frames(rec48):
+    """The server may send at most ``credits`` batch frames ahead of
+    grants — the ring's semaphore contract, extended over the wire."""
+    srv = RemoteShardServer(host="127.0.0.1", port=0,
+                            max_shards=1).start()
+    cli = mx_rpc.RpcClient("127.0.0.1", srv.port, fault_scope=None)
+    try:
+        cli.connect(timeout=5.0)
+        cli.send(_epoch_msg(rec48, credits=2))
+        first = _drain_frames(cli, want=6, wait_s=3.0)
+        # exactly the granted 2 in flight, no matter how long we wait
+        assert len(first) == 2, [m.get("op") for m in first]
+        cli.send({"op": "credit", "shard": 0, "n": 2})
+        more = _drain_frames(cli, want=6, wait_s=3.0)
+        assert len(more) == 2
+        seqs = [m["seq"] for m in first + more]
+        assert seqs == [0, 1, 2, 3]     # in order, none lost
+        # grant the rest: 2 remaining DATA batches + the END marker
+        cli.send({"op": "credit", "shard": 0, "n": 10})
+        tail = _drain_frames(cli, want=3, wait_s=5.0)
+        assert [m["kind"] for m in tail] == [1, 1, 2]
+    finally:
+        cli.close()
+        srv.close()
+
+
+# -------------------------------------------------- fault injection
+def test_garbled_frame_poisons_one_link_only(rec48, monkeypatch):
+    """data_service:net corrupt: the CRC check rejects the frame,
+    that connection dies, the shard reconnects at its cursors —
+    bit-identical stream, one restart charged, shard stays remote."""
+    with _service(rec48, 3) as local:
+        ref = _np_batches(local)
+    srv = RemoteShardServer(host="127.0.0.1", port=0,
+                            max_shards=2).start()
+    monkeypatch.setenv("MXTPU_FAULT_SPEC", "data_service:net:2:corrupt")
+    rz.reset_faults()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        with _service(rec48, 3,
+                      remote_addrs=[f"127.0.0.1:{srv.port}"]) as svc:
+            got = _np_batches(svc)
+            st = svc.stats()
+    srv.close()
+    assert st["restarts"] == 1
+    assert st["remote_shards"] == 1      # reconnected, not demoted
+    _assert_same(got, ref, "garbled frame")
+    assert not _wait_shm_clean()
+
+
+def test_host_kill_chaos_demotes_and_stays_bit_identical(
+        rec48, tmp_path, monkeypatch):
+    """data_service:host kill: the server process hard-exits before
+    its nth batch frame (the CLI entrypoint, a real subprocess).  The
+    shard re-homes to a local worker at its cursors; the epoch stays
+    bit-identical; no shm segment or socket survives."""
+    with _service(rec48, 3) as local:
+        ref = _np_batches(local)
+    pf = str(tmp_path / "port")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               MXTPU_FAULT_SPEC="data_service:host:2:kill")
+    env.setdefault("PYTHONPATH", REPO)
+    proc = subprocess.Popen(
+        [sys.executable, "-m",
+         "incubator_mxnet_tpu.data_service.net",
+         "--port-file", pf, "--shards", "1"],
+        env=env, stderr=subprocess.PIPE)
+    try:
+        deadline = time.monotonic() + 30
+        while not os.path.exists(pf) \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert os.path.exists(pf), "server never wrote its port file"
+        port = int(open(pf).read())
+        monkeypatch.setenv("MXTPU_DATA_HOST_GRACE", "3")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            with _service(rec48, 3,
+                          remote_addrs=[f"127.0.0.1:{port}"]) as svc:
+                got = _np_batches(svc)
+                st = svc.stats()
+        assert st["restarts"] >= 1
+        assert st["remote_shards"] == 0          # demoted to local
+        _assert_same(got, ref, "host kill mid-epoch")
+        err = proc.stderr.read().decode()
+        assert "MXTPU_KILLED injected data_service:host kill" in err
+        assert proc.wait(timeout=10) != 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+        proc.stderr.close()
+    # the SIGKILLed host's decode worker dies via PDEATHSIG and the
+    # resource tracker unlinks its ring segment: nothing may survive
+    assert not _wait_shm_clean()
+
+
+def test_unreachable_remote_falls_back_to_local(rec48, monkeypatch):
+    """A dead addr at epoch start burns one restart and re-homes the
+    shard locally — the job degrades, it does not die."""
+    with _service(rec48, 2) as local:
+        ref = _np_batches(local)
+    monkeypatch.setenv("MXTPU_DATA_HOST_GRACE", "1")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        with _service(rec48, 2,
+                      remote_addrs=["127.0.0.1:1"]) as svc:
+            got = _np_batches(svc)
+            st = svc.stats()
+    assert st["restarts"] == 1 and st["remote_shards"] == 0
+    _assert_same(got, ref, "unreachable remote")
+
+
+def test_fault_grammar_accepts_data_service_scopes():
+    assert rz.parse_fault_spec("data_service:net:2:corrupt") == \
+        [("data_service", "net", 2, "corrupt")]
+    assert rz.parse_fault_spec("data_service:host:1:kill") == \
+        [("data_service", "host", 1, "kill")]
+    with pytest.raises(ValueError):
+        rz.parse_fault_spec("record:read:1:kill")
+    with pytest.raises(ValueError):
+        rz.parse_fault_spec("elastic:rank0:1:corrupt")
+
+
+# ---------------------------------------------------------- lint
+def _load_lint():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "lint", os.path.join(REPO, "ci", "lint.py"))
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+    return lint
+
+
+def test_lint_socket_wait_rule_covers_net_module(tmp_path):
+    lint = _load_lint()
+    d = tmp_path / "incubator_mxnet_tpu" / "data_service"
+    d.mkdir(parents=True)
+    f = d / "net.py"
+    f.write_text("def f(sock):\n    return sock.recv(4)\n")
+    assert any("unbounded socket" in p for p in lint.check_file(f))
+    f.write_text("def f(cli):\n    return cli.recv(timeout=0.2)\n")
+    assert not any("unbounded socket" in p
+                   for p in lint.check_file(f))
+    # wall-clock time is banned in the failover timing logic too
+    f.write_text("import time\n\ndef f():\n    return time.time()\n")
+    assert any("time.time" in p for p in lint.check_file(f))
+
+
+# -------------------------------------------------------- launch
+def _load_launch():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "launch", os.path.join(REPO, "tools", "launch.py"))
+    launch = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(launch)
+    return launch
+
+
+def _fleet_args(launch, **kw):
+    import argparse
+    kw.setdefault("port", 29500)
+    kw.setdefault("env", [])
+    kw.setdefault("heartbeat_interval", 2.0)
+    kw.setdefault("heartbeat_timeout", 0.0)
+    kw.setdefault("max_restarts", 0)
+    kw.setdefault("ssh_cmd", "ssh")
+    return argparse.Namespace(**kw)
+
+
+def test_launch_data_fleet_addrs_and_slots():
+    launch = _load_launch()
+    args = _fleet_args(launch)
+    fleet = launch._DataFleet(args, [("h1", 2), ("h2", 1)], None)
+    # one stream per slot, fixed ports derived from --port, stable
+    # across respawns (the exported value must outlive any server)
+    assert fleet.addrs() == "h1:30500,h1:30500,h2:30501"
+    img_s, restarts, healthy, total = fleet.telemetry()
+    assert (img_s, restarts, healthy, total) == (0.0, 0, 0, 2)
+    lines = fleet.report_lines()
+    assert len(lines) == 2 and "down" in lines[0]
+
+
+def test_launch_status_line_shows_data_fleet():
+    launch = _load_launch()
+    agg = launch._aggregate_telemetry({})
+    agg["data_fleet"] = (1234.0, 1, 1, 2)
+    line = launch._format_status(agg)
+    assert "remote data: 1/2 host(s) 1234 img/s restarts=1" in line
+
+
+def test_launch_error_counters_include_net_restarts():
+    launch = _load_launch()
+    assert "data_service_net_restarts_total" in launch._ERROR_COUNTERS

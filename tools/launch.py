@@ -199,6 +199,7 @@ _ERROR_COUNTERS = ("retry_attempts_total", "collective_aborts_total",
                    "data_quarantined_records_total",
                    "dataloader_worker_restarts_total",
                    "data_service_worker_restarts_total",
+                   "data_service_net_restarts_total",
                    "sentinel_bad_steps_total",
                    "sentinel_skipped_steps_total",
                    "sentinel_divergences_total", "rollbacks_total",
@@ -322,6 +323,14 @@ def _format_status(agg):
         parts.append(f"{agg['throughput']:.1f} samples/s")
     if agg.get("data_img_s", 0) > 0:
         parts.append(f"data: {agg['data_img_s']:.0f} img/s")
+    if agg.get("data_fleet") is not None:
+        img_s, restarts, healthy, total = agg["data_fleet"]
+        part = f"remote data: {healthy}/{total} host(s)"
+        if img_s > 0:
+            part += f" {img_s:.0f} img/s"
+        if restarts:
+            part += f" restarts={restarts}"
+        parts.append(part)
     if agg.get("serve_queue", 0) > 0:
         parts.append(f"serve queue: {agg['serve_queue']} req "
                      f"({agg['serve_queued_tokens']} tok)")
@@ -386,7 +395,7 @@ def _format_report(snaps):
 
 
 def _run_once(spawners, hb_files=None, hb_timeout=0,
-              status_interval=0):
+              status_interval=0, data_fleet=None):
     """Start every worker; first nonzero exit tears the job down (a
     crashing worker mid-collective leaves peers blocked forever — the
     reference's ps-lite scheduler dies the same way).
@@ -423,12 +432,19 @@ def _run_once(spawners, hb_files=None, hb_timeout=0,
                               # just wait for the reap
         while pending and rc == 0:
             now = time.time()
+            if data_fleet is not None:
+                # data hosts are supervised alongside the training
+                # monitor: hung-host kill + respawn-in-place (the
+                # training ranks' shards fail over meanwhile)
+                data_fleet.poll(now)
             if next_status is not None and now >= next_status:
                 next_status = now + status_interval
                 snaps = _collect_snapshots(hb_files)
-                if snaps:
-                    print(_format_status(_aggregate_telemetry(snaps)),
-                          file=sys.stderr)
+                if snaps or data_fleet is not None:
+                    agg = _aggregate_telemetry(snaps)
+                    if data_fleet is not None:
+                        agg["data_fleet"] = data_fleet.telemetry()
+                    print(_format_status(agg), file=sys.stderr)
             for r, p in list(pending.items()):
                 code = p.poll()
                 if code is None:
@@ -683,6 +699,221 @@ def _run_fleet(args, cmd, hb_dir):
                  if m["hb"] is not None})), file=sys.stderr)
 
 
+# ---------------------------------------------------------------------------
+# remote data-service fleet (--data-hosts, docs/data_service.md
+# "Remote ranks")
+#
+# One RemoteShardServer per hostfile entry ("host [shards]"), each
+# serving that many decode shard streams to the training ranks over
+# the framed RPC.  The launcher exports the resulting
+# MXTPU_DATA_REMOTE_ADDRS to every training rank, so any
+# DataServiceIter in the job homes its last shards on the fleet.
+# Like a serving replica (and unlike a training rank), a data host is
+# independent — its shards re-home to survivors or local workers
+# while it is down — so a dead or hung server respawns *in place* on
+# the SAME port (the exported addrs stay valid and the iterators'
+# failover reconnects) under the --max-restarts ledger.
+# ---------------------------------------------------------------------------
+
+_LOCAL_HOSTS = ("localhost", "127.0.0.1", "::1")
+
+
+class _DataFleet:
+    """Spawns and supervises the --data-hosts decode servers."""
+
+    def __init__(self, args, hosts, hb_dir):
+        self.args = args
+        self.hb_dir = hb_dir
+        self.restarts = 0
+        self.members = []
+        for i, (host, slots) in enumerate(hosts):
+            self.members.append({
+                "idx": i, "host": host, "slots": max(slots, 1),
+                # fixed port per host: the exported addr must survive
+                # a respawn, and an ssh-spawned server's ephemeral
+                # port-file would live on the wrong machine
+                "port": args.port + 1000 + i,
+                "proc": None, "hb": None, "gen": -1,
+                "killed": False})
+
+    def addrs(self):
+        """The MXTPU_DATA_REMOTE_ADDRS value (one shard stream per
+        slot: a host with K slots appears K times)."""
+        return ",".join(f"{m['host']}:{m['port']}"
+                        for m in self.members
+                        for _ in range(m["slots"]))
+
+    def _port_file(self, m):
+        if self.hb_dir is None or not self._is_local(m):
+            return None
+        return os.path.join(self.hb_dir,
+                            f"dataport-{m['idx']}-{m['gen']}")
+
+    @staticmethod
+    def _is_local(m):
+        return m["host"] in _LOCAL_HOSTS
+
+    def _spawn(self, m):
+        m["gen"] += 1
+        m["killed"] = False
+        prog = [sys.executable, "-m",
+                "incubator_mxnet_tpu.data_service.net",
+                "--host", "0.0.0.0", "--port", str(m["port"]),
+                "--shards", str(m["slots"]),
+                "--name", f"data-{m['idx']}"]
+        pf = self._port_file(m)
+        if pf is not None:
+            prog += ["--port-file", pf]
+        extra = {}
+        if self.hb_dir is not None:
+            # heartbeat files need the monitor's filesystem: only
+            # local-spawned servers get hung-host detection (the same
+            # documented de-scope as ssh-mode training workers)
+            m["hb"] = _hb_path(self.hb_dir, m["gen"],
+                               f"data-{m['idx']}")
+            extra["MXTPU_HEARTBEAT_FILE"] = m["hb"]
+            extra["MXTPU_HEARTBEAT_INTERVAL"] = \
+                str(self.args.heartbeat_interval)
+        if self._is_local(m):
+            env = dict(os.environ)
+            env.update(extra)
+            m["proc"] = subprocess.Popen(prog, env=env)
+        else:
+            m["hb"] = None      # remote file; not visible here
+            if os.environ.get("PYTHONPATH"):
+                extra.setdefault("PYTHONPATH",
+                                 os.environ["PYTHONPATH"])
+            assigns = " ".join(f"{k}={shlex.quote(v)}"
+                               for k, v in sorted(extra.items()))
+            prog_s = " ".join(shlex.quote(c) for c in prog)
+            rc = (f"cd {shlex.quote(os.getcwd())} && "
+                  f"{assigns} exec {prog_s}").replace("  ", " ")
+            m["proc"] = subprocess.Popen(
+                _ssh_argv(self.args, m["host"], rc))
+
+    def spawn_all(self, wait_s=20.0):
+        for m in self.members:
+            self._spawn(m)
+        # port-file handshake for local servers: the first epoch
+        # command must not race the listener's bind (a lost race is
+        # survivable — the shard fails over — but burns restart
+        # budget on a healthy fleet)
+        deadline = time.time() + wait_s
+        for m in self.members:
+            pf = self._port_file(m)
+            if pf is None:
+                continue
+            while not os.path.exists(pf) \
+                    and time.time() < deadline \
+                    and m["proc"].poll() is None:
+                time.sleep(0.05)
+            if not os.path.exists(pf):
+                print(f"launch.py: data host {m['host']} did not "
+                      f"write its port file within {wait_s:.0f}s; "
+                      "its shards will fail over until it comes up",
+                      file=sys.stderr)
+
+    def poll(self, now):
+        """One monitor tick: kill hung servers (stale heartbeat),
+        respawn dead ones in place under the shared restart ledger."""
+        for m in self.members:
+            p = m["proc"]
+            if p is None:
+                continue        # budget spent: permanently down
+            if p.poll() is None:
+                if self.args.heartbeat_timeout > 0 \
+                        and m["hb"] is not None and not m["killed"]:
+                    try:
+                        age = now - os.path.getmtime(m["hb"])
+                    except OSError:
+                        continue     # no heartbeat yet: unmonitored
+                    if age > self.args.heartbeat_timeout:
+                        print(f"launch.py: data host {m['host']} "
+                              f"hung (no heartbeat for {age:.0f}s > "
+                              f"{self.args.heartbeat_timeout:.0f}s);"
+                              " killing it", file=sys.stderr)
+                        p.kill()
+                        m["killed"] = True
+                continue
+            why = "hung (killed)" if m["killed"] \
+                else f"exited with {p.poll()}"
+            if self.restarts >= self.args.max_restarts:
+                print(f"launch.py: data host {m['host']} {why}; "
+                      f"restart budget spent ({self.restarts}/"
+                      f"{self.args.max_restarts}); its shards stay "
+                      "re-homed on the training ranks",
+                      file=sys.stderr)
+                m["proc"] = None
+                continue
+            self.restarts += 1
+            print(f"launch.py: data host {m['host']} {why}; "
+                  f"respawning on port {m['port']} (restart "
+                  f"{self.restarts}/{self.args.max_restarts}); its "
+                  "shards re-home until it answers",
+                  file=sys.stderr)
+            self._spawn(m)
+
+    def snapshots(self):
+        """host label -> telemetry snapshot (local servers only)."""
+        snaps = {}
+        for m in self.members:
+            if m["hb"] is None:
+                continue
+            _, snap = _read_heartbeat(m["hb"])
+            if snap is not None:
+                snaps[f"data-{m['idx']}"] = snap
+        return snaps
+
+    def telemetry(self):
+        """(remote img/s summed over hosts, fleet restarts, healthy
+        count, total) for the status line."""
+        img_s = 0.0
+        for snap in self.snapshots().values():
+            img_s += (snap.get("gauges") or {}).get(
+                "data_service_remote_img_per_sec", 0.0) or 0.0
+        healthy = sum(1 for m in self.members
+                      if m["proc"] is not None
+                      and m["proc"].poll() is None
+                      and not m["killed"])
+        return img_s, self.restarts, healthy, len(self.members)
+
+    def report_lines(self):
+        lines = []
+        snaps = self.snapshots()
+        for m in self.members:
+            alive = m["proc"] is not None \
+                and m["proc"].poll() is None
+            snap = snaps.get(f"data-{m['idx']}")
+            img_s = ((snap.get("gauges") or {}).get(
+                "data_service_remote_img_per_sec", 0.0) or 0.0) \
+                if snap else 0.0
+            frames = ((snap.get("counters") or {}).get(
+                "data_service_net_frames_total", 0)) if snap else 0
+            lines.append(
+                f"launch.py:   data host {m['host']}:{m['port']}: "
+                + ("up" if alive else "down")
+                + f" shards={m['slots']}"
+                + (f" {img_s:.0f} img/s" if img_s else "")
+                + (f" frames={frames}" if frames else ""))
+        if self.restarts:
+            lines.append(f"launch.py:   data-host restarts: "
+                         f"{self.restarts}")
+        return lines
+
+    def stop(self):
+        procs = [m["proc"] for m in self.members
+                 if m["proc"] is not None]
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.time() + 10
+        for p in procs:
+            while p.poll() is None and time.time() < deadline:
+                time.sleep(0.05)
+            if p.poll() is None:
+                p.kill()
+
+
 def main():
     ap = argparse.ArgumentParser(
         description="Launch a distributed training job")
@@ -737,6 +968,18 @@ def main():
                     "multi-process input service, "
                     "docs/data_service.md); unset leaves the "
                     "workers' own env/default")
+    ap.add_argument("--data-hosts", default=None, metavar="HOSTFILE",
+                    help="remote decode fleet (docs/data_service.md "
+                    "\"Remote ranks\"): spawn one data_service.net "
+                    "server per hostfile line ('host [shards]' — "
+                    "localhost entries spawn directly, others over "
+                    "--ssh-cmd) on fixed ports derived from --port, "
+                    "and export MXTPU_DATA_REMOTE_ADDRS to every "
+                    "training rank so their DataServiceIter homes "
+                    "its last shards on the fleet.  Dead/hung "
+                    "servers respawn in place on the same port "
+                    "under --max-restarts while the shards fail "
+                    "over; requires --launcher local or ssh")
     ap.add_argument("--nonfinite-policy", default=None,
                     choices=["off", "warn", "skip", "raise"],
                     help="export MXTPU_NONFINITE_POLICY to every "
@@ -825,6 +1068,21 @@ def main():
         finally:
             if hb_dir is not None:
                 shutil.rmtree(hb_dir, ignore_errors=True)
+
+    data_fleet = None
+    if args.data_hosts:
+        if args.launcher not in ("local", "ssh"):
+            ap.error("--data-hosts requires --launcher local or ssh")
+        data_hosts = _parse_hostfile(args.data_hosts)
+        data_fleet = _DataFleet(args, data_hosts, hb_dir)
+        data_fleet.spawn_all()
+        # every training rank sees the fleet: DataServiceIter homes
+        # its LAST len(addrs) shards on these servers
+        args.env.append(
+            f"MXTPU_DATA_REMOTE_ADDRS={data_fleet.addrs()}")
+        print(f"launch.py: data fleet: {len(data_hosts)} host(s), "
+              f"{data_fleet.addrs().count(',') + 1} shard "
+              f"stream(s) at {data_fleet.addrs()}", file=sys.stderr)
 
     if args.launcher == "local":
         def make_spawners(coord, attempt, world):
@@ -958,7 +1216,7 @@ def main():
             rc, failed = _run_once(
                 make_spawners(coord_for(attempt), attempt, world),
                 last_files, args.heartbeat_timeout,
-                args.status_interval)
+                args.status_interval, data_fleet=data_fleet)
             if rc == 0:
                 break
             if args.elastic and rc != DIVERGED_EXIT:
@@ -1020,8 +1278,13 @@ def main():
         if last_files:
             print(_format_report(_collect_snapshots(last_files)),
                   file=sys.stderr)
+        if data_fleet is not None:
+            for line in data_fleet.report_lines():
+                print(line, file=sys.stderr)
         return rc
     finally:
+        if data_fleet is not None:
+            data_fleet.stop()
         if hb_dir is not None:
             shutil.rmtree(hb_dir, ignore_errors=True)
 
