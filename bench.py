@@ -38,24 +38,39 @@ def _stage(msg, tag=""):
 BATCH = int(os.environ.get("MXTPU_BENCH_BATCH", "32"))
 WARMUP_STEPS = 3
 MEASURE_STEPS = 20
-# ResNet-50 @224: ~4.089 GFLOPs forward per image; train step ~= 3x fwd
-FLOPS_PER_IMG = 3 * 4.089e9
+# ResNet-50 @224 train FLOPs per image with multiply-add counted as
+# 2 — the convention of both perf.cost_model and the hardware peaks,
+# so MFU numerator and denominator finally agree.  7.826 GFLOPs fwd
+# is the graph cost pass's count for resnet50_v1 at (1,3,224,224);
+# train step ~= 3x fwd.  No longer a source of truth: the bench
+# recomputes it from the traced graph and dies loudly past +-2%
+# drift (_crosscheck_resnet_flops).  The pre-r18 constant 3*4.089e9
+# counted multiply-adds as 1, halving reported MFU.
+FLOPS_PER_IMG = 3 * 7.826e9
 
-# peak dense FLOP/s per chip for the compute dtype we use (bf16 on
-# TPU, fp32 elsewhere); device_kind substring -> peak
-_PEAK_FLOPS = [
-    ("v6", 918e12), ("v5p", 459e12), ("v5e", 197e12),
-    ("v5litepod", 197e12), ("v5 lite", 197e12),
-    ("v4", 275e12), ("v3", 123e12), ("v2", 45e12),
-]
+
+def _peak_for(device, dtype="bfloat16"):
+    """Peak dense FLOP/s for the bench compute dtype.  Single source
+    of truth is the perf device DB (perf/device_db.py — it absorbed
+    this module's former _PEAK_FLOPS table); still None for unknown
+    accelerator kinds so MFU is omitted rather than wrong."""
+    from incubator_mxnet_tpu.perf import peak_flops
+    return peak_flops(device, dtype)
 
 
-def _peak_for(device):
-    kind = getattr(device, "device_kind", "").lower()
-    for tag, peak in _PEAK_FLOPS:
-        if tag in kind:
-            return peak
-    return None
+def _crosscheck_resnet_flops(net):
+    """FLOPS_PER_IMG is a cross-check, not a source of truth: the
+    graph cost pass recomputes the traced model's train FLOPs and a
+    >2% disagreement (model edit, cost-model regression) kills the
+    bench before it prints a wrong MFU."""
+    from incubator_mxnet_tpu import perf, sym
+    s = net._to_symbol(sym.Variable("data"))
+    rep = perf.symbol_cost(s, {"data": (1, 3, 224, 224)}).scaled(3.0)
+    drift = abs(rep.flops - FLOPS_PER_IMG) / FLOPS_PER_IMG
+    assert drift <= 0.02, (
+        f"FLOPS_PER_IMG={FLOPS_PER_IMG:.4e} disagrees with the graph "
+        f"cost pass {rep.flops:.4e} by {drift:.1%} (>2%)")
+    return rep
 
 
 _PROBE_SRC = """
@@ -256,6 +271,15 @@ def _bench_transformer(dev, platform):
     tok_s = B * L * meas / dt
     peak = _peak_for(dev) if dev is not None else None
     flops_tok = net.train_flops_per_token(L)
+    # cross-check (not two truths): the model's own accounting must
+    # agree with the perf package's transformer formula within 2%
+    from incubator_mxnet_tpu import perf
+    ref_tok = perf.transformer_train_flops_per_token(
+        d_model=D, n_layers=LAYERS, vocab=V, seq_len=L,
+        n_heads=HEADS, attn_window=WINDOW, moe_experts=MOE)
+    assert abs(flops_tok - ref_tok) <= 0.02 * ref_tok, (
+        f"train_flops_per_token {flops_tok:.4e} vs cost model "
+        f"{ref_tok:.4e}")
     mfu = (flops_tok * tok_s / peak) if peak else None
     assert np.isfinite(final_loss), final_loss
     print(json.dumps({
@@ -394,6 +418,197 @@ def _graph_transformer_step(sym, B=4, L=64, D=128, H=4, n_layers=2,
         shapes[f"l{i}_ff2_weight"] = (D, 4 * D)
         shapes[f"l{i}_ff2_bias"] = (D,)
     return sym.Group([logits, loss]), shapes
+
+
+def _analytic_vs_xla(s, shapes):
+    """(CostReport, xla cost dict | None, rel FLOPs delta | None)
+    for one bench graph's forward at fixed shapes — the analytic
+    pass vs XLA's own ``compiled.cost_analysis()``."""
+    import jax
+
+    from incubator_mxnet_tpu import perf
+    from incubator_mxnet_tpu.executor import build_graph_fn
+    rep = perf.symbol_cost(s, shapes)
+    arg_names = s.list_arguments()
+    aux_names = s.list_auxiliary_states()
+    known = {k: v for k, v in shapes.items()
+             if k in set(arg_names) | set(aux_names)}
+    arg_shapes, _, aux_shapes = s.infer_shape_partial(**known)
+    run = build_graph_fn(s)
+    args = {n: jax.ShapeDtypeStruct(tuple(sh), np.float32)
+            for n, sh in zip(arg_names, arg_shapes)}
+    auxs = {n: jax.ShapeDtypeStruct(tuple(sh), np.float32)
+            for n, sh in zip(aux_names, aux_shapes)}
+    rng = jax.ShapeDtypeStruct((2,), np.uint32)
+
+    def fwd(av, xv, r, _run=run):
+        return _run(av, xv, r, False)
+
+    xc = perf.jit_cost(fwd, args, auxs, rng)
+    delta = (abs(rep.flops - xc["flops"]) / xc["flops"]
+             if xc and xc.get("flops") else None)
+    return rep, xc, delta
+
+
+def _bench_perf_report(dev, platform):
+    """Perf observatory artifact (ISSUE 18, BENCH_r18.json):
+    analytic-vs-XLA deltas on the three bench graphs, per-family
+    cost/roofline tables for a transformer train step and serving
+    decode, measured MFU through the live gauges, and the bench_gate
+    trajectory summary.  CPU-runnable end to end.
+    Run with MXTPU_BENCH_MODEL=perf_report."""
+    import jax
+
+    import incubator_mxnet_tpu as mx
+    import incubator_mxnet_tpu.symbol as symmod
+    from incubator_mxnet_tpu import parallel, perf, telemetry
+    from incubator_mxnet_tpu.gluon.model_zoo.transformer import \
+        TransformerLM
+
+    def stage(msg):
+        _stage(msg, tag="perf_report")
+
+    tgt = dev if dev is not None else jax.devices("cpu")[0]
+    caps = perf.caps_for(tgt)
+    dtype = "bfloat16" if platform != "cpu" else "float32"
+
+    # ---- analytic vs XLA on the three bench graphs ----------------
+    stage("costing the three bench graphs (analytic + XLA)")
+    graphs = {}
+    for name, builder in [("mlp", _graph_mlp),
+                          ("resnet_block", _graph_resnet_block),
+                          ("transformer_step",
+                           _graph_transformer_step)]:
+        s, shapes = builder(symmod)
+        rep, xc, delta = _analytic_vs_xla(s, shapes)
+        graphs[name] = {
+            "analytic_gflops": round(rep.flops / 1e9, 4),
+            "xla_gflops": round(xc["flops"] / 1e9, 4) if xc else None,
+            "rel_delta": round(delta, 4) if delta is not None
+            else None,
+            "coverage": rep.coverage,
+        }
+
+    # ---- transformer train step: live gauges + per-family table ---
+    stage("train step: arming gauges, measuring")
+    V, D, LAYERS, HEADS, B, L = 512, 128, 2, 4, 4, 64
+    mx.random.seed(0)
+    net = TransformerLM(V, d_model=D, n_layers=LAYERS, n_heads=HEADS,
+                        max_len=L)
+    net.initialize(mx.initializer.Xavier())
+    ex = mx.nd.array(np.zeros((2, L), "int32"))
+    step = parallel.ShardedTrainStep(
+        net, optimizer="sgd", optimizer_params=dict(learning_rate=.1),
+        example_args=[ex], mesh=parallel.make_mesh(devices=[tgt]))
+    rs = np.random.RandomState(0)
+    toks = jax.device_put(
+        np.asarray(rs.randint(0, V, (B, L)), np.int32), tgt)
+    labels = jax.device_put(
+        np.asarray(rs.randint(0, V, (B, L)), np.int32), tgt)
+    xla_step = step.cost_analysis(toks, labels)  # arms the MFU clock
+    flops_tok = net.train_flops_per_token(L)
+    step.arm_perf(flops_per_step=flops_tok * B * L,
+                  bytes_per_step=(xla_step or {}).get("bytes", 0.0),
+                  tokens_per_step=B * L)
+    for _ in range(2):
+        loss = step(toks, labels)
+    float(loss)
+    n_steps = 20            # 2x the default MXTPU_PERF_INTERVAL
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        loss = step(toks, labels)
+    final_loss = float(loss)
+    dt = time.perf_counter() - t0
+    assert np.isfinite(final_loss), final_loss
+    snap = telemetry.snapshot()
+    g = snap.get("gauges", snap) or {}
+    train_flops_step = flops_tok * B * L
+    train_bytes_step = (xla_step or {}).get("bytes", 0.0)
+    train = {
+        "model": {"vocab": V, "d_model": D, "n_layers": LAYERS,
+                  "n_heads": HEADS, "batch": B, "seq": L},
+        "step_ms": round(1e3 * dt / n_steps, 2),
+        "tokens_per_s": round(B * L * n_steps / dt, 1),
+        "mfu": g.get("train_mfu"),
+        "mbu": g.get("train_mbu"),
+        "gauge_tokens_per_s": g.get("train_tokens_per_sec"),
+        "analytic_step_gflops": round(train_flops_step / 1e9, 4),
+        "xla_step_cost": xla_step,
+        "roofline": perf.roofline(train_flops_step, train_bytes_step,
+                                  caps, dtype),
+    }
+    srep, _, sdelta = _analytic_vs_xla(
+        *_graph_transformer_step(symmod))
+    train["per_family"] = srep.scaled(3.0).table(caps, dtype)
+    train["graph_rel_delta"] = round(sdelta, 4) \
+        if sdelta is not None else None
+
+    # ---- serving decode: live engine + analytic decode report -----
+    stage("serving decode: streaming through the engine")
+    from incubator_mxnet_tpu.serving.engine import ServingEngine
+    srv = TransformerLM(256, d_model=D, n_layers=LAYERS,
+                        n_heads=HEADS, max_len=96)
+    srv.initialize(mx.initializer.Xavier())
+    srv(mx.nd.array(np.zeros((1, 4), "int32")))
+    eng = ServingEngine(srv, max_batch=4, block_size=8,
+                        num_blocks=64)
+    rs = np.random.RandomState(1)
+    for _ in range(8):
+        eng.submit([int(t) for t in rs.randint(1, 256, 12)],
+                   max_new_tokens=16)
+    t0 = time.perf_counter()
+    events = list(eng.stream())
+    s_dt = time.perf_counter() - t0
+    snap = telemetry.snapshot()
+    g = snap.get("gauges", snap) or {}
+    serving = {
+        "requests": 8, "tokens": len(events),
+        "tokens_per_s": round(len(events) / s_dt, 1),
+        "mfu": g.get("serving_mfu"),
+        "flops_per_token": g.get("serving_flops_per_token"),
+        "report": eng.perf_report(),
+    }
+
+    # ---- bench_gate trajectory over the committed history ---------
+    stage("normalizing the BENCH history")
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import bench_gate
+    history = bench_gate.load_history()
+    gate = {
+        "band": float(os.environ.get("MXTPU_PERF_GATE_BAND", 0.10)),
+        "records": len(history),
+        "metrics": bench_gate.trajectory_summary(history),
+    }
+
+    doc = {
+        "metric": "perf_report",
+        "platform": platform,
+        "device_kind": getattr(dev, "device_kind", "cpu")
+        if dev is not None else "cpu",
+        "compute_dtype": dtype,
+        "nominal_peaks": bool(caps.nominal),
+        "graphs": graphs,
+        "train": train,
+        "serving": serving,
+        "bench_gate": gate,
+    }
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "BENCH_r18.json")
+    with open(out, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=False)
+    print(json.dumps({
+        "metric": "perf_report",
+        "platform": platform,
+        "graph_deltas": {k: v["rel_delta"]
+                         for k, v in graphs.items()},
+        "train_mfu": train["mfu"],
+        "train_bound": train["roofline"]["bound"],
+        "serving_tokens_per_s": serving["tokens_per_s"],
+        "serving_mfu": serving["mfu"],
+        "gate_metrics": len(gate["metrics"]),
+        "wrote": out,
+    }))
 
 
 def _bench_graph(dev, platform):
@@ -1885,6 +2100,9 @@ def main():
     if os.environ.get("MXTPU_BENCH_MODEL") == "data_service_net":
         _bench_data_service_net(dev, platform)
         return
+    if os.environ.get("MXTPU_BENCH_MODEL") == "perf_report":
+        _bench_perf_report(dev, platform)
+        return
 
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import parallel
@@ -1896,6 +2114,8 @@ def main():
         net.initialize(mx.initializer.Xavier())
         x1 = jnp.zeros((1, 3, 224, 224), jnp.float32)
         pure = parallel.functionalize(net, x1)
+        stage("model built; cross-checking FLOPs vs the cost model")
+        _crosscheck_resnet_flops(net)
 
     rs = np.random.RandomState(0)
     x_np = np.asarray(rs.rand(BATCH, 3, 224, 224), np.float32)

@@ -81,6 +81,10 @@ HOT_SYNC_FILES = (
     # read array METADATA only — an accidental device sync here
     # would stall the hot paths every beat
     "incubator_mxnet_tpu/tracing.py",
+    # perf observatory: the MFU clock ticks on EVERY train step and
+    # the serving publisher runs inside the decode loop — both are
+    # wall-clock-only by contract (docs/observability.md)
+    "incubator_mxnet_tpu/perf/clock.py",
 )
 HOT_SYNC_FUNCS = {"step", "update", "__call__", "begin_step",
                   "guarded_step_begin", "read_window_bad",
@@ -97,7 +101,10 @@ HOT_SYNC_FUNCS = {"step", "update", "__call__", "begin_step",
                   "snapshot", "stream_request", "_stream_gen",
                   # tracing producers + memory sampling
                   "trace_event", "record", "device_memory_stats",
-                  "update_memory_gauges", "_rss_bytes"}
+                  "update_memory_gauges", "_rss_bytes",
+                  # perf observatory (MFU gauges must stay
+                  # wall-clock-only; docs/observability.md)
+                  "tick", "_publish_perf"}
 # attrs that always sync, and ones that sync only for specific roots
 SYNC_ATTRS = {"item", "asscalar", "asnumpy"}
 SYNC_ROOT_ATTRS = {("np", "asarray"), ("numpy", "asarray"),
@@ -611,6 +618,56 @@ def check_env_vars(files):
 FAULT_SCOPE_FACTORIES = {"inject", "fault_for"}
 
 
+def check_op_cost_coverage(files):
+    """Every canonical op name in the ops registry must have a cost
+    entry in perf/cost_model.py — a FLOPs formula, membership in
+    ZERO_COST, or a DEFAULT_COST entry with a non-empty escape
+    reason (docs/observability.md "Perf observatory").  The elemwise
+    cost tables are loop-generated at import time, so this check
+    imports the real registry instead of walking the AST; it only
+    runs when the lint set includes the op/cost sources (partial-tree
+    lint runs in tests skip it)."""
+    cost_py = Path("incubator_mxnet_tpu/perf/cost_model.py")
+    if not cost_py.exists():
+        return []
+    touched = any(
+        f.as_posix().startswith(("incubator_mxnet_tpu/ops/",
+                                 "incubator_mxnet_tpu/perf/"))
+        for f in files)
+    if not touched:
+        return []
+    try:
+        import importlib
+        # lint runs as `python ci/lint.py` — the package root (cwd)
+        # is not on sys.path automatically
+        if str(Path.cwd()) not in sys.path:
+            sys.path.insert(0, str(Path.cwd()))
+        importlib.import_module("incubator_mxnet_tpu")
+        reg = importlib.import_module(
+            "incubator_mxnet_tpu.ops.registry")
+        cm = importlib.import_module(
+            "incubator_mxnet_tpu.perf.cost_model")
+    except Exception as exc:
+        return [f"{cost_py}: op-cost coverage check could not import "
+                f"the op registry: {exc!r}"]
+    canonical = {op.name for op in reg.OPS.values()}
+    problems = [
+        f"{cost_py}: op {name!r} has no cost entry — add a FLOPs "
+        "formula or list it in ZERO_COST/DEFAULT_COST (with a "
+        "reason)" for name in cm.coverage_gaps(canonical)]
+    for name, reason in sorted(cm.DEFAULT_COST.items()):
+        if not str(reason).strip():
+            problems.append(
+                f"{cost_py}: DEFAULT_COST[{name!r}] has an empty "
+                "escape reason")
+    stale = sorted((set(cm._FAMILY) | cm.ZERO_COST
+                    | set(cm.DEFAULT_COST)) - canonical)
+    problems.extend(
+        f"{cost_py}: cost entry {name!r} matches no registered op "
+        "(stale after a registry rename?)" for name in stale)
+    return problems
+
+
 def check_fault_scopes(files):
     """Every literal fault scope used in code must be documented in
     docs/resilience.md's injection grammar (ops may be dynamic —
@@ -747,6 +804,7 @@ def main(argv):
     problems.extend(check_env_vars(files))
     problems.extend(check_metric_catalog(files))
     problems.extend(check_fault_scopes(files))
+    problems.extend(check_op_cost_coverage(files))
     for p in problems:
         print(p)
     print(f"lint: {len(files)} files, {len(problems)} problems")

@@ -11,7 +11,12 @@ cd "$(dirname "$0")/.."
 
 stage="${1:-all}"
 
-run_lint() { python ci/lint.py; }
+run_lint() {
+  python ci/lint.py
+  # bench regression gate: the committed BENCH history must gate
+  # clean (latest round vs best-so-far within the noise band)
+  python tools/bench_gate.py --check
+}
 
 run_native() {
   # the recordio module self-builds its .so from src/recordio on

@@ -909,6 +909,27 @@ class TransformerLM(Block):
         fwd = self.n_layers * per_layer + 2 * d * vocab
         return 3 * fwd
 
+    def decode_flops_per_token(self, context_len):
+        """Matmul FLOPs to decode ONE token against a KV cache of
+        ``context_len`` entries (no 3x rule — forward only), for
+        ``serving_mfu`` accounting (docs/observability.md)."""
+        d = self._d
+        hid = self._mlp_ratio * d
+        if self.moe_experts:
+            e = self.moe_experts
+            mlp = 2 * (2 * 2 * d * hid) + 2 * d * e
+        else:
+            mlp = 2 * 2 * d * hid
+        kvd = self.n_kv_heads * (d // self.n_heads)
+        span = min(context_len, self.attn_window) \
+            if self.attn_window else context_len
+        per_layer = (2 * d * (d + 2 * kvd)
+                     + 2 * d * d
+                     + 2 * 2 * span * d
+                     + mlp)
+        vocab = self.head._units
+        return self.n_layers * per_layer + 2 * d * vocab
+
 
 def transformer_lm(vocab_size=32000, size="small", **kwargs):
     """Factory: 'small' (125M-class), 'medium' (350M-class),

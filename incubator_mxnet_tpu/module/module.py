@@ -52,6 +52,9 @@ class Module(BaseModule):
         self._mesh_dirty = False    # step params newer than exec dicts
         self._mesh_pending = False  # fused step ran; update() owes a no-op
         self._mesh_stale = False    # exec dicts newer than step params
+        self._perf_clock = None     # MFU gauges (perf observatory)
+        self._perf_cost = None      # cached graph CostReport (3x fwd)
+        self._perf_tried = False    # don't re-cost after a failure
 
     # ------------------------------------------------------------ bind
     @property
@@ -408,6 +411,13 @@ class Module(BaseModule):
         DivergedError for fit's checkpoint rollback."""
         assert self.optimizer_initialized
         telemetry.counter("train_steps_total").inc()
+        # perf observatory: wall-clock-only MFU clock — the mesh
+        # step ticks its own, so only the executor path ticks here
+        if self._mesh_step is None:
+            if self._perf_clock is None and not self._perf_tried:
+                self._arm_perf_clock()
+            if self._perf_clock is not None:
+                self._perf_clock.tick()
         if self._mesh_step is not None:
             if self._mesh_pending:
                 # the optimizer already ran inside the fused mesh
@@ -473,6 +483,102 @@ class Module(BaseModule):
                 self._updater(i, grad, self._exec.arg_dict[name])
             else:
                 self._updater(i, grad, self._exec.arg_dict[name])
+
+    # ------------------------------------------------------------ perf
+    def _bound_shapes(self):
+        """Variable name -> shape for everything the bind fixed."""
+        shapes = {d.name: tuple(d.shape) for d in self._data_shapes}
+        shapes.update({d.name: tuple(d.shape)
+                       for d in (self._label_shapes or [])})
+        for n in self._param_names:
+            shapes[n] = tuple(self._exec.arg_dict[n].shape)
+        for n in self._aux_names:
+            shapes[n] = tuple(self._exec.aux_dict[n].shape)
+        return shapes
+
+    def _graph_cost(self):
+        """Analytic CostReport of one TRAIN step (3x forward) at the
+        bound shapes; cached per bind."""
+        if self._perf_cost is None:
+            from .. import perf
+            self._perf_cost = perf.symbol_cost(
+                self._symbol, self._bound_shapes()).scaled(3.0)
+        return self._perf_cost
+
+    def _arm_perf_clock(self):
+        """One-time arm of the train_mfu/train_mbu clock from the
+        graph cost model (bind-time work; never re-tried on
+        failure, never on the step path)."""
+        self._perf_tried = True
+        try:
+            from .. import perf
+            rep = self._graph_cost()
+            self._perf_clock = perf.TrainPerfClock(rep.flops,
+                                                   rep.bytes)
+        except Exception:
+            self._perf_clock = None
+
+    def perf_report(self, xla_check=True):
+        """Per-family cost/roofline report for the bound graph
+        (docs/observability.md "Perf observatory").
+
+        Returns a dict: ``per_family`` rows (flops%, bytes%,
+        predicted-time%, bound-by label, arithmetic intensity),
+        ``total`` summary, coverage counts, the device roofline
+        verdict for one train step, and — when the backend reports
+        ``cost_analysis()`` — the analytic-vs-XLA forward-FLOPs
+        delta."""
+        assert self.binded, "call bind before perf_report"
+        import jax
+
+        from .. import perf
+        rep = self._graph_cost()
+        dev = jax.devices()[0]
+        caps = perf.caps_for(dev)
+        dtype = str(next(iter(self._exec.arg_dict.values())).dtype) \
+            if self._exec.arg_dict else "float32"
+        out = {
+            "per_family": rep.table(caps, dtype),
+            "total": rep.summary(),
+            "coverage": rep.coverage,
+            "default_ops": rep.default_ops,
+            "unknown_ops": rep.unknown_ops,
+            "roofline": perf.roofline(rep.flops, rep.bytes, caps,
+                                      dtype),
+            "device": caps.as_dict(),
+            "n_nodes": rep.n_nodes,
+        }
+        if xla_check:
+            out["xla_check"] = self._xla_fwd_delta(rep)
+        return out
+
+    def _xla_fwd_delta(self, train_rep):
+        """Analytic-vs-XLA forward FLOPs delta via the executor's
+        compiled forward (AOT lowering; nothing executes).  None
+        when the backend doesn't report cost_analysis()."""
+        import jax
+
+        from .. import perf
+        try:
+            fwd = self._exec._get_fwd(False)
+            args = {n: jax.ShapeDtypeStruct(tuple(v.shape),
+                                            v.dtype)
+                    for n, v in self._exec.arg_dict.items()}
+            auxs = {n: jax.ShapeDtypeStruct(tuple(v.shape),
+                                            v.dtype)
+                    for n, v in self._exec.aux_dict.items()}
+            import numpy as np
+            rng = jax.ShapeDtypeStruct((2,), np.dtype("uint32"))
+            xc = perf.jit_cost(fwd, args, auxs, rng)
+        except Exception:
+            return None
+        if not xc or not xc.get("flops"):
+            return None
+        analytic_fwd = train_rep.flops / 3.0
+        return {"analytic_fwd_flops": analytic_fwd,
+                "xla_fwd_flops": xc["flops"],
+                "rel_delta": abs(analytic_fwd - xc["flops"])
+                / xc["flops"]}
 
     def get_outputs(self, merge_multi_context=True):
         return self._exec.outputs

@@ -159,6 +159,9 @@ class ShardedTrainStep:
         self.opt_state = self.opt.init(self.params)
         self._step = None
         self._eval = None
+        # perf observatory: armed by cost_analysis()/arm_perf(); a
+        # ticking clock publishes train_mfu/train_mbu from wall time
+        self._perf_clock = None
 
     # ---------------------------------------------------------------- build
     def _input_sharding(self, ndim, is_label=False):
@@ -280,9 +283,31 @@ class ShardedTrainStep:
              self.step_count, loss) = self._step(
                 self.params, self.states, self.opt_state,
                 self.step_count, x, y, rng)
+        if self._perf_clock is not None:
+            self._perf_clock.tick()   # wall-clock only, no syncs
         return loss
 
     step = __call__
+
+    def arm_perf(self, flops_per_step=0.0, bytes_per_step=0.0,
+                 tokens_per_step=0.0, dtype=None):
+        """Arm the train_mfu/train_mbu/train_tokens_per_sec gauges
+        with an analytic per-step cost (e.g. from the graph cost
+        model or ``model.train_flops_per_token * tokens``).  The
+        clock reads only wall time — zero added device syncs."""
+        from ..perf import TrainPerfClock
+        if dtype is None:
+            dtype = str(self.compute_dtype) if self.compute_dtype \
+                else "float32"
+        dev = self.mesh.devices.flat[0]
+        if self._perf_clock is None:
+            self._perf_clock = TrainPerfClock(
+                flops_per_step, bytes_per_step, tokens_per_step,
+                device=dev, dtype=dtype)
+        else:
+            self._perf_clock.arm(flops_per_step, bytes_per_step,
+                                 tokens_per_step, device=dev)
+        return self._perf_clock
 
     def memory_analysis(self, x, y):
         """XLA's compiled-buffer accounting for this train step (the
@@ -312,6 +337,34 @@ class ShardedTrainStep:
             return compiled.memory_analysis()
         except Exception:
             return None
+
+    def cost_analysis(self, x, y):
+        """XLA's FLOP/bytes-accessed accounting for this train step —
+        the sibling of :meth:`memory_analysis`, and the cross-check
+        anchor for the analytic cost model (docs/observability.md
+        "Perf observatory").  Returns ``{"flops", "bytes"}`` or None
+        where the backend doesn't report.  On success the
+        train_mfu/train_mbu clock is armed with the measured step
+        cost (if not already armed), so subsequent steps publish MFU
+        with no further compiles or syncs."""
+        x, y = _raw(x), _raw(y)
+        if self._step is None:
+            self._step = self._build(x, y)
+        xa = jax.ShapeDtypeStruct(
+            x.shape, x.dtype, sharding=self._input_sharding(x.ndim))
+        ya = jax.ShapeDtypeStruct(
+            y.shape, y.dtype,
+            sharding=self._input_sharding(y.ndim, True))
+        rng = jax.random.PRNGKey(0)   # traced arg; value irrelevant
+        with use_mesh(self.mesh):
+            compiled = self._step.lower(
+                self.params, self.states, self.opt_state,
+                self.step_count, xa, ya, rng).compile()
+        from ..perf import xla_cost
+        cost = xla_cost(compiled)
+        if cost is not None and self._perf_clock is None:
+            self.arm_perf(cost["flops"], cost["bytes"])
+        return cost
 
     def evaluate(self, x, rng=None):
         """Compiled inference forward on a global batch."""

@@ -1,0 +1,25 @@
+"""Perf observatory: analytic graph cost model, device capability
+DB, roofline/MFU attribution (docs/observability.md).
+
+    from incubator_mxnet_tpu import perf
+    report = perf.symbol_cost(sym, {"data": (32, 784)})
+    rows = report.table(perf.caps_for_kind("v5e"))
+"""
+from .cost_model import (CostReport, DEFAULT_COST, ZERO_COST,
+                         coverage_gaps, covered_ops, jit_cost,
+                         symbol_cost,
+                         transformer_decode_cost,
+                         transformer_decode_flops_per_token,
+                         transformer_train_flops_per_token, xla_cost)
+from .device_db import (DEVICE_DB, DeviceCaps, caps_for,
+                        caps_for_kind, peak_flops, roofline)
+from .clock import TrainPerfClock
+
+__all__ = [
+    "CostReport", "DEFAULT_COST", "ZERO_COST", "coverage_gaps",
+    "covered_ops", "jit_cost", "symbol_cost",
+    "transformer_decode_cost", "transformer_decode_flops_per_token",
+    "transformer_train_flops_per_token", "xla_cost",
+    "DEVICE_DB", "DeviceCaps", "caps_for", "caps_for_kind",
+    "peak_flops", "roofline", "TrainPerfClock",
+]

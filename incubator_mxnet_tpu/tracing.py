@@ -490,10 +490,14 @@ class CompileLedger:
         self._sigs = deque(maxlen=self.MAX_SIGS)
         self._lock = threading.Lock()
 
-    def record(self, signature, seconds):
+    def record(self, signature, seconds, cost=None):
         """Attribute + publish one compile.  ``signature`` is the
         flat component dict (see :func:`signature_diff`); ``seconds``
-        the wall-clock trace+compile time the caller measured.
+        the wall-clock trace+compile time the caller measured;
+        ``cost`` (optional) the analytic cost-model summary of the
+        recompiled graph (``perf.CostReport.summary()``: total
+        GFLOPs, GBytes, arithmetic intensity), so retrace
+        attribution also says how expensive the graph is.
         Returns the attribution reason.
 
         Honors the disabled-mode contract: with ``MXTPU_TELEMETRY=0``
@@ -508,9 +512,11 @@ class CompileLedger:
             self._sigs.append(sig)
         telemetry.counter("compile_events_total").inc()
         telemetry.histogram("compile_seconds").observe(seconds)
+        extra = {"cost": dict(cost)} if cost else {}
         trace_event("compile", site=self.site, reason=reason,
                     changed=changed, seconds=round(float(seconds), 6),
-                    signature={k: repr(v) for k, v in sig.items()})
+                    signature={k: repr(v) for k, v in sig.items()},
+                    **extra)
         _budget_check(self.site, seconds)
         return reason
 

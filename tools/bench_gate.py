@@ -1,0 +1,345 @@
+#!/usr/bin/env python
+"""Bench regression gate (docs/observability.md "Perf observatory").
+
+The committed ``BENCH_r*.json`` history is heterogeneous — every
+round wrote whatever shape its experiment needed, so the trajectory
+is write-only: nothing reads it, nothing fails when a number gets
+worse.  This tool makes it a gate:
+
+1. **Normalize** each round into schema-versioned headline records::
+
+       {"schema": "bench-v1", "round": 7,
+        "metric": "serving_tokens_per_s", "value": 774.9,
+        "unit": "tok/s", "higher_is_better": true}
+
+   via per-experiment extractors keyed on the file's ``metric``
+   field (r01-style driver wrappers ``{"n", "rc", "parsed"}`` are
+   unwrapped first; failed rounds normalize to zero records).
+2. **Trajectory** — per-metric series over rounds, best-so-far and
+   latest (``--summary`` prints it; ``--append`` persists new
+   records as ``bench-v1`` lines in PROGRESS.jsonl next to the
+   driver's own progress lines).
+3. **Gate** — a fresh run (``--fresh FILE``) or the latest committed
+   round (``--check``, the ci mode) must not be worse than the
+   best-so-far of any shared metric by more than the noise band
+   (``MXTPU_PERF_GATE_BAND``, default 10%), direction-aware: for
+   higher-is-better metrics the floor is ``best * (1 - band)``, for
+   lower-is-better the ceiling is ``best * (1 + band)``.  Any
+   violation prints ``bench_gate: REGRESSION`` and exits 1.
+
+Usage::
+
+    python tools/bench_gate.py --check            # ci
+    python tools/bench_gate.py --summary
+    python tools/bench_gate.py --fresh out.json   # gate + append
+"""
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCHEMA = "bench-v1"
+
+
+def _band_default():
+    try:
+        sys.path.insert(0, REPO)
+        from incubator_mxnet_tpu.utils.env import get_env
+        return float(get_env("MXTPU_PERF_GATE_BAND"))
+    except Exception:
+        return 0.10
+
+
+def _get(d, path):
+    for k in path:
+        if not isinstance(d, dict) or k not in d:
+            return None
+        d = d[k]
+    return d if isinstance(d, (int, float)) \
+        and not isinstance(d, bool) else None
+
+
+def _best_service_img_s(doc):
+    best = None
+    for v in (doc.get("service") or {}).values():
+        x = _get(v, ("img_s_median",))
+        if x is not None and (best is None or x > best):
+            best = x
+    return best
+
+
+def _graph_opt_reduction(doc):
+    """Mean reduction_pct at each graph's highest optimize level."""
+    vals = []
+    for g in (doc.get("graphs") or {}).values():
+        levels = g.get("levels") or {}
+        if not levels:
+            continue
+        top = max(levels, key=lambda k: int(k))
+        x = _get(levels[top], ("reduction_pct",))
+        if x is not None:
+            vals.append(x)
+    return sum(vals) / len(vals) if vals else None
+
+
+# metric-field -> [(headline name, getter, unit, higher_is_better)]
+_EXTRACTORS = {
+    "resnet50_train_throughput_batch32_1chip": [
+        ("resnet50_train_samples_per_s", lambda d: _get(d, ("value",)),
+         "samples/s", True),
+        ("resnet50_train_mfu", lambda d: _get(d, ("mfu",)),
+         "mfu", True),
+    ],
+    "graph_opt_pipeline": [
+        ("graph_opt_reduction_pct", _graph_opt_reduction, "%", True),
+    ],
+    "serving_continuous_batching": [
+        ("serving_tokens_per_s",
+         lambda d: _get(d, ("continuous", "tokens_per_s")),
+         "tok/s", True),
+        ("serving_speedup_vs_static",
+         lambda d: _get(d, ("speedup_continuous_vs_static",)),
+         "x", True),
+    ],
+    "tracing_flight_recorder": [
+        ("tracing_tokens_per_s",
+         lambda d: _get(d, ("throughput", "tokens_per_s_tracing_on")),
+         "tok/s", True),
+    ],
+    "data_service_input_throughput": [
+        ("data_service_img_per_s", _best_service_img_s,
+         "img/s", True),
+    ],
+    "serving_overload_shedding": [
+        ("serving_capacity_req_per_s",
+         lambda d: _get(d, ("stream", "capacity_req_per_s")),
+         "req/s", True),
+        ("serving_shed_ttft_p99_s",
+         lambda d: _get(d, ("overload_shed", "ttft_p99_s")),
+         "s", False),
+    ],
+    "serving_fleet_failover": [
+        ("fleet_failover_p50_s",
+         lambda d: _get(d, ("failover", "latency_s", "p50")),
+         "s", False),
+    ],
+    "data_service_net_loopback_throughput": [
+        ("data_loopback_local_img_per_s",
+         lambda d: _get(d, ("throughput_img_s", "local", "median")),
+         "img/s", True),
+    ],
+    "perf_report": [
+        ("perf_train_mfu", lambda d: _get(d, ("train", "mfu")),
+         "mfu", True),
+        ("perf_serving_tokens_per_s",
+         lambda d: _get(d, ("serving", "tokens_per_s")),
+         "tok/s", True),
+    ],
+}
+
+
+def normalize(doc, round_no=None):
+    """One bench document -> list of bench-v1 headline records.
+
+    Unwraps the r01-style driver envelope ({"n","rc","parsed"}),
+    returns [] for rounds with no recognizable headline (failed
+    probes stay in the trajectory as gaps, not as zeros)."""
+    if not isinstance(doc, dict):
+        return []
+    if "parsed" in doc and "rc" in doc:
+        round_no = doc.get("n", round_no)
+        doc = doc.get("parsed")
+        if not isinstance(doc, dict):
+            return []
+    recs = []
+    for name, fn, unit, hib in _EXTRACTORS.get(
+            doc.get("metric", ""), []):
+        v = fn(doc)
+        if v is None:
+            continue
+        recs.append({"schema": SCHEMA, "round": round_no,
+                     "metric": name, "value": float(v), "unit": unit,
+                     "higher_is_better": hib})
+    return recs
+
+
+def normalize_file(path):
+    m = re.search(r"BENCH_r(\d+)\.json$", os.path.basename(path))
+    round_no = int(m.group(1)) if m else None
+    with open(path) as f:
+        doc = json.load(f)
+    return normalize(doc, round_no)
+
+
+def load_history(repo=REPO):
+    """All committed rounds, normalized, sorted by round number."""
+    recs = []
+    for path in sorted(glob.glob(os.path.join(repo,
+                                              "BENCH_r*.json"))):
+        recs.extend(normalize_file(path))
+    return sorted(recs, key=lambda r: (r["round"] or 0, r["metric"]))
+
+
+def gate(fresh, history, band):
+    """Compare fresh records against best-so-far per metric.
+
+    Returns (failures, checked): failures are dicts describing each
+    regression past the noise band; metrics with no history are
+    skipped (first measurement can't regress)."""
+    best = {}
+    for r in history:
+        b = best.get(r["metric"])
+        if b is None or (r["value"] > b["value"]) == \
+                r["higher_is_better"]:
+            best[r["metric"]] = r
+    failures, checked = [], 0
+    for r in fresh:
+        b = best.get(r["metric"])
+        if b is None:
+            continue
+        checked += 1
+        if r["higher_is_better"]:
+            limit = b["value"] * (1.0 - band)
+            bad = r["value"] < limit
+        else:
+            limit = b["value"] * (1.0 + band)
+            bad = r["value"] > limit
+        if bad:
+            failures.append({
+                "metric": r["metric"], "value": r["value"],
+                "best": b["value"], "best_round": b["round"],
+                "limit": limit, "unit": r["unit"],
+                "higher_is_better": r["higher_is_better"]})
+    return failures, checked
+
+
+def trajectory_summary(records):
+    """Per-metric {rounds, best, latest, unit} over a record list."""
+    out = {}
+    for r in records:
+        t = out.setdefault(r["metric"], {
+            "unit": r["unit"], "rounds": [], "best": r["value"],
+            "latest": r["value"],
+            "higher_is_better": r["higher_is_better"]})
+        t["rounds"].append(r["round"])
+        if (r["value"] > t["best"]) == r["higher_is_better"]:
+            t["best"] = r["value"]
+        t["latest"] = r["value"]
+    return out
+
+
+def append_progress(records, path=None):
+    """Persist bench-v1 records into PROGRESS.jsonl (dedup on
+    (round, metric) against lines already carrying this schema)."""
+    path = path or os.path.join(REPO, "PROGRESS.jsonl")
+    seen = set()
+    if os.path.exists(path):
+        with open(path) as f:
+            for line in f:
+                try:
+                    d = json.loads(line)
+                except ValueError:
+                    continue
+                if d.get("schema") == SCHEMA:
+                    seen.add((d.get("round"), d.get("metric")))
+    new = [r for r in records
+           if (r["round"], r["metric"]) not in seen]
+    if new:
+        with open(path, "a") as f:
+            for r in new:
+                f.write(json.dumps(r, sort_keys=True) + "\n")
+    return len(new)
+
+
+def _print_failures(failures):
+    for f in failures:
+        arrow = "<" if f["higher_is_better"] else ">"
+        print(f"bench_gate: REGRESSION {f['metric']}: "
+              f"{f['value']:g} {f['unit']} {arrow} gate "
+              f"{f['limit']:g} (best {f['best']:g} at round "
+              f"r{f['best_round']})")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--check", action="store_true",
+                    help="ci mode: gate the latest committed round "
+                         "against the earlier history")
+    ap.add_argument("--fresh", metavar="FILE",
+                    help="gate a fresh bench output file against the "
+                         "committed history; append on pass")
+    ap.add_argument("--summary", action="store_true",
+                    help="print the normalized trajectory")
+    ap.add_argument("--band", type=float, default=None,
+                    help="noise band (default MXTPU_PERF_GATE_BAND)")
+    ap.add_argument("--no-append", action="store_true",
+                    help="with --fresh: skip the PROGRESS.jsonl "
+                         "append")
+    ap.add_argument("--append", action="store_true",
+                    help="append the full normalized history to "
+                         "PROGRESS.jsonl")
+    args = ap.parse_args(argv)
+    band = args.band if args.band is not None else _band_default()
+
+    history = load_history()
+    if not history:
+        print("bench_gate: no BENCH_r*.json history found")
+        return 2 if (args.check or args.fresh) else 0
+
+    rc = 0
+    if args.summary or not (args.check or args.fresh
+                            or args.append):
+        traj = trajectory_summary(history)
+        print(f"bench_gate: {len(history)} records, "
+              f"{len(traj)} metrics, band {band:.0%}")
+        for name, t in sorted(traj.items()):
+            rounds = ",".join(f"r{r}" for r in t["rounds"])
+            print(f"bench_gate:   {name}: best {t['best']:g} "
+                  f"{t['unit']} latest {t['latest']:g} ({rounds})")
+
+    if args.check:
+        latest = max(r["round"] or 0 for r in history)
+        fresh = [r for r in history if (r["round"] or 0) == latest]
+        prior = [r for r in history if (r["round"] or 0) != latest]
+        failures, checked = gate(fresh, prior, band)
+        _print_failures(failures)
+        if failures:
+            rc = 1
+        else:
+            print(f"bench_gate: OK — round r{latest} "
+                  f"({checked} shared metric(s) gated, "
+                  f"{len(fresh) - checked} first-seen)")
+
+    if args.fresh:
+        with open(args.fresh) as f:
+            doc = json.load(f)
+        latest = max(r["round"] or 0 for r in history)
+        fresh = normalize(doc, round_no=latest + 1)
+        if not fresh:
+            print(f"bench_gate: {args.fresh}: no recognizable "
+                  "headline metrics")
+            return 2
+        failures, checked = gate(fresh, history, band)
+        _print_failures(failures)
+        if failures:
+            rc = 1
+        else:
+            print(f"bench_gate: OK — {args.fresh} "
+                  f"({checked} shared metric(s) gated)")
+            if not args.no_append:
+                n = append_progress(fresh)
+                print(f"bench_gate: appended {n} record(s) to "
+                      "PROGRESS.jsonl")
+
+    if args.append:
+        n = append_progress(history)
+        print(f"bench_gate: appended {n} record(s) to "
+              "PROGRESS.jsonl")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
