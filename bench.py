@@ -611,6 +611,278 @@ def _bench_perf_report(dev, platform):
     }))
 
 
+def _bench_memory(dev, platform):
+    """Memory-pressure survival artifact (BENCH_r19.json,
+    docs/memory.md): planner-vs-XLA peak-HBM deltas on the three
+    bench train graphs, a deterministic degrade-ladder walk under a
+    shrunk MXTPU_HBM_BYTES, timed recovery from an injected mem:oom
+    (loss bitwise-identical across the remat rung), and the
+    auto-sized serving KV pool against the static configuration.
+    CPU-runnable end to end.  Run with MXTPU_BENCH_MODEL=memory."""
+    import jax
+    import jax.numpy as jnp
+
+    import incubator_mxnet_tpu as mx
+    import incubator_mxnet_tpu.symbol as symmod
+    from incubator_mxnet_tpu import parallel, resilience, telemetry
+    from incubator_mxnet_tpu.executor import build_graph_fn
+    from incubator_mxnet_tpu.perf import memory_planner as mp
+
+    def stage(msg):
+        _stage(msg, tag="memory")
+
+    graph_inputs = {"mlp": {"data", "label"},
+                    "resnet_block": {"data"},
+                    "transformer_step": {"tokens", "labels"}}
+
+    def train_compiled(s, shapes, inputs, grad_accum=1):
+        """Donated SGD train step lowered straight from the Symbol —
+        abstract specs only, nothing executes."""
+        arg_names = s.list_arguments()
+        aux_names = s.list_auxiliary_states()
+        known = {k: v for k, v in shapes.items()
+                 if k in set(arg_names) | set(aux_names)}
+        arg_shapes, _, aux_shapes = s.infer_shape_partial(**known)
+        run = build_graph_fn(s)
+        all_args = {n: tuple(sh)
+                    for n, sh in zip(arg_names, arg_shapes)}
+        auxs = {n: jax.ShapeDtypeStruct(tuple(sh), np.float32)
+                for n, sh in zip(aux_names, aux_shapes)}
+        params = {n: jax.ShapeDtypeStruct(sh, np.float32)
+                  for n, sh in all_args.items() if n not in inputs}
+        datas = {n: jax.ShapeDtypeStruct(
+            sh, np.int32 if ("label" in n or "tokens" in n)
+            else np.float32)
+            for n, sh in all_args.items() if n in inputs}
+        rng = jax.ShapeDtypeStruct((2,), np.uint32)
+
+        def lossf(p, d, av, r):
+            fwd = run({**p, **{k: v.astype(np.float32)
+                               for k, v in d.items()}}, av, r, True)
+            outs = fwd[0] if isinstance(fwd, tuple) else fwd
+            loss = outs[-1] if isinstance(outs, (list, tuple)) \
+                else outs
+            return jnp.mean(loss)
+
+        def step(p, d, av, r):
+            if grad_accum <= 1:
+                loss, g = jax.value_and_grad(lossf)(p, d, av, r)
+            else:
+                def micro(carry, dslice):
+                    gsum, lsum = carry
+                    mloss, mg = jax.value_and_grad(lossf)(
+                        p, dslice, av, r)
+                    gsum = jax.tree_util.tree_map(
+                        lambda a, b: a + b, gsum, mg)
+                    return (gsum, lsum + mloss), None
+
+                dm = {k: d[k].reshape(
+                    (grad_accum, d[k].shape[0] // grad_accum)
+                    + d[k].shape[1:]) for k in sorted(datas)}
+                zeros = jax.tree_util.tree_map(jnp.zeros_like, p)
+                (g, loss), _ = jax.lax.scan(
+                    micro, (zeros, jnp.zeros((), jnp.float32)), dm)
+            newp = jax.tree_util.tree_map(
+                lambda a, b: a - 0.1 * b, p, g)
+            return loss, newp
+
+        return (jax.jit(step, donate_argnums=(0,))
+                .lower(params, datas, auxs, rng).compile())
+
+    # ---- planner vs XLA on the three bench train graphs -----------
+    stage("planner vs memory_analysis on the bench graphs")
+    graphs, deltas = {}, []
+    # executables loaded back from the persistent compile cache lose
+    # their alias table (alias_size_in_bytes=0), which double-counts
+    # every donated output — force fresh compiles for the cross-check
+    jax.config.update("jax_enable_compilation_cache", False)
+    try:
+        for name, builder in [("mlp", _graph_mlp),
+                              ("resnet_block", _graph_resnet_block),
+                              ("transformer_step",
+                               _graph_transformer_step)]:
+            s, shapes = builder(symmod)
+            inputs = graph_inputs[name]
+            entry = {}
+            for accum in (1, 2):
+                if name == "transformer_step" and accum > 1:
+                    continue     # hardcoded batch in head reshapes
+                c = train_compiled(s, shapes, inputs,
+                                   grad_accum=accum)
+                xla = mp.xla_live_bytes(c.memory_analysis())
+                plan = mp.plan_memory(s, shapes, input_names=inputs,
+                                      grad_accum=accum)
+                rel = ((plan.total() - xla) / xla) if xla else None
+                if rel is not None:
+                    deltas.append(abs(rel))
+                entry[f"accum{accum}"] = {
+                    "planned_mb": round(plan.total() / (1 << 20), 2),
+                    "xla_mb": round(xla / (1 << 20), 2)
+                    if xla else None,
+                    "rel_delta": round(rel, 4) if rel is not None
+                    else None,
+                }
+            live = mp.symbol_liveness(s, shapes, input_names=inputs)
+            b = mp.plan_memory(liveness=live)
+            r = mp.plan_memory(liveness=live, remat=True)
+            entry["remat_activation_shrink"] = round(
+                1.0 - r.activations / b.activations, 4) \
+                if b.activations else None
+            graphs[name] = entry
+    finally:
+        jax.config.update("jax_enable_compilation_cache", True)
+    max_abs_delta = max(deltas) if deltas else None
+
+    # ---- degrade ladder under a shrunk HBM override ---------------
+    stage("walking the degrade ladder under a shrunk capacity")
+    s, shapes = _graph_mlp(symmod)
+    live = mp.symbol_liveness(s, shapes,
+                              input_names=graph_inputs["mlp"])
+
+    def make(remat, accum):
+        return mp.plan_memory(liveness=live, remat=remat,
+                              grad_accum=accum)
+
+    base_b, remat_b = make(False, 1).total(), make(True, 1).total()
+    mem_keys = ("MXTPU_HBM_BYTES", "MXTPU_MEM_GATE_MARGIN",
+                "MXTPU_MEM_POLICY", "MXTPU_FAULT_SPEC")
+    saved = {k: os.environ.get(k) for k in mem_keys}
+    try:
+        os.environ["MXTPU_MEM_GATE_MARGIN"] = "0"
+        os.environ["MXTPU_HBM_BYTES"] = \
+            str(int((base_b + remat_b) / 2))
+        res = mp.preflight(make, site="bench_memory",
+                           can_remat=True, batch_size=32)
+        ladder = {
+            "base_mb": round(base_b / (1 << 20), 2),
+            "capacity_mb": round((base_b + remat_b) / 2 / (1 << 20),
+                                 2),
+            "rungs": list(res.rungs),
+            "settled_mb": round(res.plan.total() / (1 << 20), 2),
+        }
+        os.environ["MXTPU_HBM_BYTES"] = "4096"
+        try:
+            mp.preflight(make, site="bench_memory", can_remat=True,
+                         batch_size=32)
+            ladder["dry_ladder_typed"] = False
+        except resilience.MemoryPlanError as err:
+            ladder["dry_ladder_typed"] = True
+            ladder["dry_rungs"] = list(err.rungs)
+    finally:
+        for k, v in saved.items():
+            os.environ.pop(k, None) if v is None \
+                else os.environ.__setitem__(k, v)
+
+    # ---- injected mem:oom: one rung + retry, timed ----------------
+    stage("injected mem:oom: timing the rung + retry")
+
+    def tiny_step():
+        mx.random.seed(0)
+        net = mx.gluon.nn.HybridSequential()
+        with net.name_scope():
+            net.add(mx.gluon.nn.Dense(64, activation="relu",
+                                      in_units=32))
+            net.add(mx.gluon.nn.Dense(8, in_units=64))
+        net.initialize(mx.initializer.Xavier())
+        return parallel.ShardedTrainStep(
+            net, optimizer="sgd",
+            optimizer_params=dict(learning_rate=0.1),
+            mesh=parallel.make_mesh())
+
+    rs = np.random.RandomState(0)
+    x = np.asarray(rs.rand(16, 32), np.float32)
+    y = np.asarray(rs.randint(0, 8, (16,)), np.int32)
+    ref_step = tiny_step()
+    ref = [float(np.asarray(ref_step(x, y, rng=jax.random.PRNGKey(i))))
+           for i in range(3)]
+    try:
+        os.environ["MXTPU_FAULT_SPEC"] = "mem:oom:2:error"
+        resilience.reset_faults()
+        retries0 = telemetry.get_registry().counter(
+            "oom_retries_total").value
+        step = tiny_step()
+        got = [float(np.asarray(
+            step(x, y, rng=jax.random.PRNGKey(0))))]
+        t0 = time.perf_counter()        # this call eats the OOM
+        got.append(float(np.asarray(
+            step(x, y, rng=jax.random.PRNGKey(1)))))
+        recovery_s = time.perf_counter() - t0
+        got.append(float(np.asarray(
+            step(x, y, rng=jax.random.PRNGKey(2)))))
+        oom_doc = {
+            "rung": "remat" if step.remat else
+            f"grad_accum={step.grad_accum}",
+            "recovery_ms": round(1e3 * recovery_s, 1),
+            "losses_bitwise_identical": got == ref,
+            "oom_retries_total": telemetry.get_registry().counter(
+                "oom_retries_total").value - retries0,
+        }
+    finally:
+        os.environ.pop("MXTPU_FAULT_SPEC", None)
+        resilience.reset_faults()
+
+    # ---- serving KV pool: auto-sized vs static --------------------
+    stage("auto-sizing the serving KV pool")
+    from incubator_mxnet_tpu.gluon.model_zoo.transformer import \
+        TransformerLM
+    from incubator_mxnet_tpu.serving.engine import ServingEngine
+
+    def tiny_lm():
+        mx.random.seed(0)
+        net = TransformerLM(256, d_model=64, n_layers=2, n_heads=4,
+                            max_len=96)
+        net.initialize(mx.initializer.Xavier())
+        net(mx.nd.array(np.zeros((1, 4), "int32")))
+        return net
+
+    try:
+        os.environ["MXTPU_HBM_BYTES"] = str(16 << 20)
+        auto = ServingEngine(tiny_lm(), max_batch=4, block_size=8,
+                             num_blocks="auto")
+        static = ServingEngine(tiny_lm(), max_batch=4, block_size=8,
+                               num_blocks=64)
+        serving = {
+            "hbm_override_mb": 16,
+            "auto_num_blocks": auto.num_blocks,
+            "static_num_blocks": static.num_blocks,
+            "floor": auto.max_batch + 1,
+            "cap": auto.max_batch * auto.max_blocks + 1,
+            "auto_kv_pool_mb": round(
+                2.0 * 2 * auto.block_size * 4 * 16
+                * auto.num_blocks * 4 / (1 << 20), 2),
+        }
+    finally:
+        os.environ.pop("MXTPU_HBM_BYTES", None)
+
+    doc = {
+        "metric": "memory_pressure",
+        "platform": platform,
+        "device_kind": getattr(dev, "device_kind", "cpu")
+        if dev is not None else "cpu",
+        "graphs": graphs,
+        "max_abs_rel_delta": round(max_abs_delta, 4)
+        if max_abs_delta is not None else None,
+        "ladder": ladder,
+        "oom_recovery": oom_doc,
+        "serving_auto": serving,
+    }
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "BENCH_r19.json")
+    with open(out, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=False)
+    print(json.dumps({
+        "metric": "memory_pressure",
+        "platform": platform,
+        "max_abs_rel_delta": doc["max_abs_rel_delta"],
+        "ladder_rungs": ladder["rungs"],
+        "oom_recovery_ms": oom_doc["recovery_ms"],
+        "losses_bitwise_identical":
+            oom_doc["losses_bitwise_identical"],
+        "auto_num_blocks": serving["auto_num_blocks"],
+        "wrote": out,
+    }))
+
+
 def _bench_graph(dev, platform):
     """Graph-optimization pipeline bench (ISSUE 6 acceptance): pre/
     post-pass node counts per level, golden equivalence of the bound
@@ -2102,6 +2374,9 @@ def main():
         return
     if os.environ.get("MXTPU_BENCH_MODEL") == "perf_report":
         _bench_perf_report(dev, platform)
+        return
+    if os.environ.get("MXTPU_BENCH_MODEL") == "memory":
+        _bench_memory(dev, platform)
         return
 
     import incubator_mxnet_tpu as mx

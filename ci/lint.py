@@ -199,6 +199,30 @@ GRAPH_NODE_DICT_ATTRS = {"inputs", "attrs", "params"}
 GRAPH_LIST_MUTATORS = {"append", "extend", "insert", "remove", "pop",
                        "clear", "reverse", "sort"}
 
+# Typed OOM guard (docs/memory.md "Runtime OOM guard").  In the
+# execution layers, a try/except that wraps a compile or
+# device-execute call and catches broad ``Exception`` must route the
+# caught exception through the typed guard
+# (resilience.as_oom_error/is_oom/OomError): a real
+# RESOURCE_EXHAUSTED swallowed or re-raised untyped here loses the
+# predicted-vs-actual post-mortem AND the exit-15 contract the
+# launcher keys on.  A deliberate broad handler carries
+# `# oom-ok: <why>` on its except line.
+OOM_GUARD_DIRS = (
+    "incubator_mxnet_tpu/parallel/",
+    "incubator_mxnet_tpu/module/",
+    "incubator_mxnet_tpu/serving/",
+)
+# calls that compile for, or execute on, the device: the jit/AOT
+# surface plus the conventional compiled-step fields (self._step is
+# the built step function in both train-step classes; self._build
+# traces + compiles it)
+OOM_EXEC_ATTRS = {"jit", "compile", "lower", "device_put",
+                  "block_until_ready"}
+OOM_EXEC_SELF_ATTRS = {"_step", "_build"}
+OOM_GUARD_NAMES = {"as_oom_error", "check_oom", "is_oom",
+                   "OomError", "MemoryPlanError"}
+
 
 def _is_binary_write_open(node):
     """True for ``open(..., "wb"/"wb+"/...)`` calls."""
@@ -358,6 +382,70 @@ def _socket_wait_problems(path, tree, lines):
     return problems
 
 
+def _oom_guard_problems(path, tree, lines):
+    """Flag broad ``except`` handlers around compile/device-execute
+    calls (OOM_GUARD_DIRS) whose body never consults the typed OOM
+    guard.  A handler passes when it references one of
+    OOM_GUARD_NAMES (the as_oom_error routing pattern) or carries an
+    ``oom-ok`` annotation on its except line."""
+    problems = []
+
+    def _is_exec_call(node):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)):
+            return False
+        if node.func.attr in OOM_EXEC_ATTRS:
+            return True
+        return node.func.attr in OOM_EXEC_SELF_ATTRS \
+            and isinstance(node.func.value, ast.Name) \
+            and node.func.value.id == "self"
+
+    def _broad(handler):
+        if handler.type is None:        # bare except
+            return True
+        kinds = handler.type.elts \
+            if isinstance(handler.type, ast.Tuple) \
+            else [handler.type]
+        for k in kinds:
+            name = k.attr if isinstance(k, ast.Attribute) else (
+                k.id if isinstance(k, ast.Name) else None)
+            # XlaRuntimeError IS the RESOURCE_EXHAUSTED carrier —
+            # catching it specifically still needs the typed routing
+            if name in ("Exception", "BaseException",
+                        "XlaRuntimeError"):
+                return True
+        return False
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Try):
+            continue
+        if not any(_is_exec_call(w)
+                   for stmt in node.body for w in ast.walk(stmt)):
+            continue
+        for handler in node.handlers:
+            if not _broad(handler):
+                continue
+            line = lines[handler.lineno - 1] \
+                if handler.lineno - 1 < len(lines) else ""
+            if "oom-ok" in line:
+                continue
+            if any((isinstance(w, ast.Name)
+                    and w.id in OOM_GUARD_NAMES)
+                   or (isinstance(w, ast.Attribute)
+                       and w.attr in OOM_GUARD_NAMES)
+                   for stmt in handler.body for w in ast.walk(stmt)):
+                continue
+            problems.append(
+                f"{path}:{handler.lineno}: broad except around a "
+                "compile/execute call without the typed OOM guard — "
+                "a real RESOURCE_EXHAUSTED dies untyped here, "
+                "losing the exit-15 contract and the predicted-vs-"
+                "actual post-mortem; route it through "
+                "resilience.as_oom_error/is_oom (docs/memory.md) or "
+                "annotate the except line with '# oom-ok: <why>'")
+    return problems
+
+
 def _imported_names(tree):
     """name -> lineno for every import binding."""
     out = {}
@@ -412,6 +500,9 @@ def check_file(path):
     if any(posix.endswith(m) for m in SOCKET_WAIT_FILES):
         problems.extend(
             _socket_wait_problems(path, tree, src.splitlines()))
+    if any(d in posix for d in OOM_GUARD_DIRS):
+        problems.extend(
+            _oom_guard_problems(path, tree, src.splitlines()))
     if "incubator_mxnet_tpu" in posix and \
             not any(d in posix for d in GRAPH_MUTATION_DIRS):
         problems.extend(

@@ -112,6 +112,7 @@ class Module(BaseModule):
                     req[n] = grad_req
         else:
             req = grad_req
+        self._preflight_memory(shapes, for_training)
         self._exec = self._symbol.simple_bind(
             self._context, grad_req=req, **shapes)
         # pass-pipeline outcome of this bind (docs/graph_passes.md):
@@ -129,6 +130,31 @@ class Module(BaseModule):
             self.init_params(arg_params=arg, aux_params=aux,
                              force_init=True)
             self._preloaded_params = None
+
+    def _preflight_memory(self, shapes, for_training):
+        """Analytic HBM gate at bind time (docs/memory.md): plan the
+        executor's peak live bytes (eager grads, no donation) against
+        device capacity per MXTPU_MEM_POLICY.  The single-executor
+        path has no remat/grad_accum rungs, so the ladder is empty —
+        the plan fits, warns, or raises a typed MemoryPlanError
+        before any compile.  Planner failures on exotic graphs are
+        non-fatal; the gate is a guard, not a dependency."""
+        from ..perf import memory_planner as mp
+        from ..resilience import MemoryPlanError
+        try:
+            live = mp.symbol_liveness(self._symbol, dict(shapes),
+                                      input_names=list(shapes))
+            mp.preflight(
+                lambda r, a: mp.plan_memory(
+                    liveness=live, train=for_training,
+                    donate=False, grad_accum=a, remat=r),
+                site="module_bind")
+        except MemoryPlanError:
+            raise
+        except Exception:
+            self.logger.debug(
+                "memory preflight skipped (planning failed)",
+                exc_info=True)
 
     # ------------------------------------------------------------ params
     def init_params(self, initializer=None, arg_params=None,

@@ -20,6 +20,8 @@ The sync-point discipline matches the reference: the step is async
 (dispatch returns immediately); reading the loss (`float(...)`) is the
 WaitForVar analog.
 """
+import logging
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -162,6 +164,11 @@ class ShardedTrainStep:
         # perf observatory: armed by cost_analysis()/arm_perf(); a
         # ticking clock publishes train_mfu/train_mbu from wall time
         self._perf_clock = None
+        # memory planner (docs/memory.md): the preflight gate's
+        # accepted plan + the cached forward-liveness walk (both
+        # bind-time artifacts — nothing here runs on the step path)
+        self._mem_plan = None
+        self._mem_liveness = None
 
     # ---------------------------------------------------------------- build
     def _input_sharding(self, ndim, is_label=False):
@@ -262,6 +269,112 @@ class ShardedTrainStep:
         return jax.jit(step, in_shardings=in_sh, out_shardings=out_sh,
                        donate_argnums=donate)
 
+    # ------------------------------------------------------- memory plan
+    def _trace_liveness(self, x, y):
+        """Abstract-shape walk of the forward loss (jaxpr_liveness) —
+        the activation term of the memory plan.  Cached; traces once
+        at preflight time, never on the step path."""
+        if self._mem_liveness is not None:
+            return
+        from ..perf.memory_planner import jaxpr_liveness
+        pure, loss_fn, cdt = self.pure, self.loss_fn, self.compute_dtype
+
+        def fwd(p, s, xa, ya, rng):
+            if cdt is not None:
+                p = _cast_floats(p, cdt)
+                xa = _cast_floats(xa, cdt)
+            outs, _ = pure.apply(p, s, [xa], rng, training=True)
+            return loss_fn(outs, ya)
+
+        abst = lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)
+        with use_mesh(self.mesh):
+            self._mem_liveness = jaxpr_liveness(
+                fwd, jax.tree_util.tree_map(abst, self.params),
+                jax.tree_util.tree_map(abst, self.states),
+                abst(x), abst(y),
+                jax.ShapeDtypeStruct((2,), jnp.uint32))
+
+    def _memory_plan(self, remat, grad_accum):
+        """Per-device MemoryPlan for this step at the given knobs:
+        sharded param/optimizer slice bytes (ZeRO/tp aware) + the
+        traced activation liveness."""
+        from ..perf import memory_planner as mp
+        params_b = mp.sharded_tree_bytes(
+            self.params, self.param_shardings) \
+            + mp.tree_bytes(self.states)
+        return mp.plan_memory(
+            liveness=self._mem_liveness,
+            params_bytes=params_b,
+            max_param_bytes=mp.max_leaf_bytes(
+                self.params, self.param_shardings),
+            optimizer_bytes=mp.sharded_tree_bytes(self.opt_state),
+            grad_accum=grad_accum, remat=remat,
+            donate=self._donate,
+            batch_shards=int(self.mesh.shape.get("dp", 1)))
+
+    def _preflight(self, x, y):
+        """Consult the analytic HBM plan before the first compile;
+        under MXTPU_MEM_POLICY=degrade a predicted overflow walks the
+        ladder (remat -> next grad_accum divisor) and the step adopts
+        the surviving knobs.  Planner failures on exotic blocks are
+        non-fatal (the gate is a guard, not a dependency); a dry
+        ladder's MemoryPlanError stays loud."""
+        from ..perf.memory_planner import preflight
+        from ..resilience import MemoryPlanError
+        try:
+            self._trace_liveness(x, y)
+            res = preflight(
+                lambda r, a: self._memory_plan(r, a),
+                site="sharded_train_step",
+                device=self.mesh.devices.flat[0],
+                can_remat=True,
+                batch_size=int(x.shape[0])
+                if self.batch_axis == 0 else 0,
+                remat=self.remat, grad_accum=self.grad_accum)
+        except MemoryPlanError:
+            raise
+        except Exception:
+            logging.getLogger("mxtpu.memory").debug(
+                "memory preflight skipped (planning failed)",
+                exc_info=True)
+            return
+        if res is not None:
+            self.remat = res.remat
+            self.grad_accum = res.grad_accum
+            self._mem_plan = res.plan
+
+    def _oom_rung(self, oom, x):
+        """One runtime degrade rung after a real (or injected) OOM at
+        compile/execute: enable remat, else bump grad_accum to the
+        next batch divisor, then rebuild for the single retry.  A dry
+        ladder re-raises the typed OomError.  MXTPU_MEM_POLICY=off
+        opts out of automatic degrading entirely — the OomError
+        stays loud."""
+        from .. import telemetry, tracing
+        from ..perf.memory_planner import next_divisor
+        from ..utils.env import get_env
+        if str(get_env("MXTPU_MEM_POLICY")).lower() == "off":
+            raise oom
+        rung = None
+        if not self.remat:
+            self.remat, rung = True, "remat"
+        elif self.batch_axis == 0:
+            nxt = next_divisor(int(x.shape[0]), self.grad_accum)
+            if nxt is not None:
+                self.grad_accum, rung = nxt, f"grad_accum={nxt}"
+        if rung is None:
+            raise oom
+        self._step = None   # rebuild with the new knobs
+        telemetry.counter("oom_retries_total").inc()
+        tracing.trace_event("mem_degrade", site="sharded_train_step",
+                            rung=rung, cause="runtime_oom")
+        logging.getLogger("mxtpu.memory").warning(
+            "OOM at sharded_train_step: degrade ladder rung '%s', "
+            "retrying once%s", rung,
+            " (numerics change: smaller micro-batches)"
+            if rung.startswith("grad_accum") else
+            " (numerics unchanged; more compute)")
+
     # ---------------------------------------------------------------- run
     def __call__(self, x, y, rng=None):
         """Run one training step on a *global* batch; returns loss."""
@@ -271,18 +384,36 @@ class ShardedTrainStep:
         if rng is None:
             from .. import random_state
             rng = random_state.next_key()
-        if self._step is None:
-            self._step = self._build(x, y)
-        x = jax.device_put(x, self._input_sharding(x.ndim))
-        y = jax.device_put(y, self._input_sharding(y.ndim, True))
-        # run (and, on the first call, trace) with this step's mesh
-        # ambient, so mesh-aware blocks (e.g. ring attention) resolve
-        # the step's mesh even when called outside use_mesh()
-        with use_mesh(self.mesh):
-            (self.params, self.states, self.opt_state,
-             self.step_count, loss) = self._step(
-                self.params, self.states, self.opt_state,
-                self.step_count, x, y, rng)
+        from ..resilience import as_oom_error, check_oom
+        for attempt in (0, 1):
+            try:
+                if self._step is None:
+                    self._preflight(x, y)
+                    self._step = self._build(x, y)
+                # mem:oom injection point (docs/resilience.md); a
+                # no-op single bool check without MXTPU_FAULT_SPEC
+                check_oom("sharded_train_step")
+                xs = jax.device_put(x, self._input_sharding(x.ndim))
+                ys = jax.device_put(
+                    y, self._input_sharding(y.ndim, True))
+                # run (and, on the first call, trace) with this
+                # step's mesh ambient, so mesh-aware blocks (e.g.
+                # ring attention) resolve the step's mesh even when
+                # called outside use_mesh()
+                with use_mesh(self.mesh):
+                    (self.params, self.states, self.opt_state,
+                     self.step_count, loss) = self._step(
+                        self.params, self.states, self.opt_state,
+                        self.step_count, xs, ys, rng)
+                break
+            except Exception as exc:
+                oom = as_oom_error(exc, "sharded_train_step",
+                                   plan=self._mem_plan)
+                if oom is None:
+                    raise
+                if attempt:
+                    raise oom from exc
+                self._oom_rung(oom, x)   # raises when the ladder is dry
         if self._perf_clock is not None:
             self._perf_clock.tick()   # wall-clock only, no syncs
         return loss
@@ -335,7 +466,7 @@ class ShardedTrainStep:
                 self.step_count, xa, ya, rng).compile()
         try:
             return compiled.memory_analysis()
-        except Exception:
+        except Exception:   # oom-ok: probing an optional backend API
             return None
 
     def cost_analysis(self, x, y):

@@ -98,6 +98,9 @@ class SymbolTrainStep:
         self.opt_state = self.opt.init(self.params)
         self._step = None
         self._eval = None
+        # preflight HBM gate (docs/memory.md): plan accepted at the
+        # first call, before the compile; None when planning failed
+        self._mem_plan = None
         # device-memory attribution (docs/observability.md): the
         # step owns the job's params and optimizer state on device;
         # weakref providers so a dropped step stops being counted
@@ -170,6 +173,48 @@ class SymbolTrainStep:
             out_shardings=(p_sh, a_sh, None, None, None),
             donate_argnums=(0, 1, 2))
 
+    def _preflight(self, vals):
+        """Consult the analytic HBM plan (docs/memory.md) before the
+        first compile.  This step fixes remat/grad_accum at graph
+        construction, so the ladder has no rungs here: the plan
+        either fits (within MXTPU_MEM_GATE_MARGIN), warns, or raises
+        a typed MemoryPlanError per MXTPU_MEM_POLICY.  Planner
+        failures on exotic graphs are non-fatal."""
+        from ..perf import memory_planner as mp
+        from ..resilience import MemoryPlanError
+        try:
+            shapes = {n: tuple(v.shape) for n, v in vals.items()}
+            shapes.update({n: tuple(v.shape)
+                           for n, v in self.params.items()})
+            shapes.update({n: tuple(v.shape)
+                           for n, v in dict(self.aux).items()})
+            dtypes = {n: str(v.dtype) for n, v in vals.items()}
+            live = mp.symbol_liveness(
+                self._symbol, shapes, dtypes=dtypes,
+                input_names=[n for n in self._input_names
+                             if n in shapes])
+            res = mp.preflight(
+                lambda r, a: mp.plan_memory(
+                    liveness=live,
+                    params_bytes=mp.tree_bytes(self.params)
+                    + mp.tree_bytes(dict(self.aux)),
+                    max_param_bytes=mp.max_leaf_bytes(self.params),
+                    optimizer_bytes=mp.tree_bytes(self.opt_state),
+                    grad_accum=a, remat=r, donate=True,
+                    batch_shards=int(self.mesh.shape.get("dp", 1))),
+                site="symbol_train_step",
+                device=self.mesh.devices.flat[0])
+        except MemoryPlanError:
+            raise
+        except Exception:
+            import logging
+            logging.getLogger("mxtpu.memory").debug(
+                "memory preflight skipped (planning failed)",
+                exc_info=True)
+            return
+        if res is not None:
+            self._mem_plan = res.plan
+
     # ------------------------------------------------------------ run
     def __call__(self, inputs, rng=None, lr=0.01):
         """Run one step on a global batch.
@@ -182,23 +227,37 @@ class SymbolTrainStep:
         if rng is None:
             from .. import random_state
             rng = random_state.next_key()
+        from ..resilience import as_oom_error, check_oom
         vals = {n: jnp.asarray(v) if not isinstance(v, jax.Array)
                 else v for n, v in inputs.items()}
         compiled = self._step is None
         t0 = time.monotonic()
-        if compiled:
-            self._step = self._build(vals)
-        vals = {n: jax.device_put(v, self._in_shard(v.ndim))
-                for n, v in vals.items()}
-        poison = 1.0
-        if self._guarded:
-            from ..optimizer import grad_poison
-            poison = grad_poison() or 1.0
-        (self.params, self.aux, self.opt_state, outs,
-         self.last_finite) = self._step(
-            self.params, self.aux, self.opt_state, vals, rng,
-            jnp.asarray(lr, jnp.float32),
-            jnp.asarray(poison, jnp.float32))
+        try:
+            if compiled:
+                self._preflight(vals)
+                self._step = self._build(vals)
+            # mem:oom injection point; free without MXTPU_FAULT_SPEC
+            check_oom("symbol_train_step")
+            vals = {n: jax.device_put(v, self._in_shard(v.ndim))
+                    for n, v in vals.items()}
+            poison = 1.0
+            if self._guarded:
+                from ..optimizer import grad_poison
+                poison = grad_poison() or 1.0
+            (self.params, self.aux, self.opt_state, outs,
+             self.last_finite) = self._step(
+                self.params, self.aux, self.opt_state, vals, rng,
+                jnp.asarray(lr, jnp.float32),
+                jnp.asarray(poison, jnp.float32))
+        except Exception as exc:
+            # route real RESOURCE_EXHAUSTED (and the injected kind)
+            # through the typed guard; this step has no runtime
+            # degrade rungs, so the OomError stays loud
+            oom = as_oom_error(exc, "symbol_train_step",
+                               plan=self._mem_plan)
+            if oom is None:
+                raise
+            raise oom from exc
         if compiled:
             cost = self._arm_perf(vals)
             # first call = trace + compile of the whole mesh step;

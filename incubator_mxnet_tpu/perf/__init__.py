@@ -1,9 +1,11 @@
 """Perf observatory: analytic graph cost model, device capability
-DB, roofline/MFU attribution (docs/observability.md).
+DB, roofline/MFU attribution, HBM memory planner
+(docs/observability.md, docs/memory.md).
 
     from incubator_mxnet_tpu import perf
     report = perf.symbol_cost(sym, {"data": (32, 784)})
     rows = report.table(perf.caps_for_kind("v5e"))
+    plan = perf.plan_memory(sym, {"data": (32, 784)})
 """
 from .cost_model import (CostReport, DEFAULT_COST, ZERO_COST,
                          coverage_gaps, covered_ops, jit_cost,
@@ -12,8 +14,14 @@ from .cost_model import (CostReport, DEFAULT_COST, ZERO_COST,
                          transformer_decode_flops_per_token,
                          transformer_train_flops_per_token, xla_cost)
 from .device_db import (DEVICE_DB, DeviceCaps, caps_for,
-                        caps_for_kind, peak_flops, roofline)
+                        caps_for_kind, hbm_capacity, headroom,
+                        peak_flops, roofline)
 from .clock import TrainPerfClock
+from .memory_planner import (MemoryPlan, PreflightResult,
+                             jaxpr_liveness, max_leaf_bytes,
+                             next_divisor, plan_memory, preflight,
+                             sharded_tree_bytes, symbol_liveness,
+                             tree_bytes, xla_live_bytes)
 
 __all__ = [
     "CostReport", "DEFAULT_COST", "ZERO_COST", "coverage_gaps",
@@ -21,5 +29,10 @@ __all__ = [
     "transformer_decode_cost", "transformer_decode_flops_per_token",
     "transformer_train_flops_per_token", "xla_cost",
     "DEVICE_DB", "DeviceCaps", "caps_for", "caps_for_kind",
-    "peak_flops", "roofline", "TrainPerfClock",
+    "hbm_capacity", "headroom", "peak_flops", "roofline",
+    "TrainPerfClock",
+    "MemoryPlan", "PreflightResult", "jaxpr_liveness",
+    "max_leaf_bytes", "next_divisor", "plan_memory", "preflight",
+    "sharded_tree_bytes", "symbol_liveness", "tree_bytes",
+    "xla_live_bytes",
 ]

@@ -23,22 +23,32 @@ report that uses them carries ``"nominal_peaks": true``.
 from ..utils.env import get_env
 
 __all__ = ["DeviceCaps", "DEVICE_DB", "caps_for_kind", "caps_for",
-           "peak_flops", "roofline"]
+           "peak_flops", "roofline", "hbm_capacity", "headroom"]
+
+# nominal per-device HBM for CPU hosts (the gate needs *a* capacity
+# to plan against off-TPU; 32 GiB is far above any CI-sized graph, so
+# the ladder only engages when MXTPU_HBM_BYTES shrinks it on purpose)
+_CPU_NOMINAL_HBM = 32 * (1 << 30)
 
 
 class DeviceCaps:
     """Peak capabilities of one device kind."""
 
     __slots__ = ("kind", "bf16_flops", "hbm_bytes_per_s", "int8_2x",
-                 "nominal")
+                 "nominal", "hbm_bytes", "nominal_hbm")
 
     def __init__(self, kind, bf16_flops, hbm_gb_s, int8_2x=False,
-                 nominal=False):
+                 nominal=False, hbm_gib=None):
         self.kind = kind
         self.bf16_flops = float(bf16_flops)
         self.hbm_bytes_per_s = float(hbm_gb_s) * 1e9
         self.int8_2x = bool(int8_2x)
         self.nominal = bool(nominal)
+        # per-chip HBM capacity; nominal_hbm marks values that are
+        # placeholders (CPU / unknown kinds) rather than datasheet
+        self.nominal_hbm = bool(nominal) or hbm_gib is None
+        self.hbm_bytes = float(
+            (hbm_gib if hbm_gib is not None else 32) * (1 << 30))
 
     def peak(self, dtype="bfloat16"):
         """Peak FLOP/s for a compute dtype (convention in the module
@@ -54,24 +64,33 @@ class DeviceCaps:
                 else self.bf16_flops / 8.0
         return self.bf16_flops
 
+    def capacity(self):
+        """Usable per-device HBM in bytes: the ``MXTPU_HBM_BYTES``
+        override when set (> 0), the generation's datasheet capacity
+        otherwise (nominal for CPU/unknown kinds)."""
+        override = float(get_env("MXTPU_HBM_BYTES"))
+        return override if override > 0 else self.hbm_bytes
+
     def as_dict(self):
         return {"kind": self.kind, "bf16_flops": self.bf16_flops,
                 "hbm_bytes_per_s": self.hbm_bytes_per_s,
-                "nominal": self.nominal}
+                "nominal": self.nominal,
+                "hbm_bytes": self.capacity(),
+                "nominal_hbm": self.nominal_hbm}
 
 
 # device_kind substring -> caps; first match wins, so keep the more
 # specific tags ("v5p", "v5litepod") ahead of shorter ones ("v5e").
 # Per-chip numbers (dense bf16 peak, HBM GB/s).
 DEVICE_DB = [
-    DeviceCaps("v6", 918e12, 1640.0, int8_2x=True),
-    DeviceCaps("v5p", 459e12, 2765.0),
-    DeviceCaps("v5e", 197e12, 819.0, int8_2x=True),
-    DeviceCaps("v5litepod", 197e12, 819.0, int8_2x=True),
-    DeviceCaps("v5 lite", 197e12, 819.0, int8_2x=True),
-    DeviceCaps("v4", 275e12, 1228.0),
-    DeviceCaps("v3", 123e12, 900.0),
-    DeviceCaps("v2", 45e12, 700.0),
+    DeviceCaps("v6", 918e12, 1640.0, int8_2x=True, hbm_gib=32),
+    DeviceCaps("v5p", 459e12, 2765.0, hbm_gib=95),
+    DeviceCaps("v5e", 197e12, 819.0, int8_2x=True, hbm_gib=16),
+    DeviceCaps("v5litepod", 197e12, 819.0, int8_2x=True, hbm_gib=16),
+    DeviceCaps("v5 lite", 197e12, 819.0, int8_2x=True, hbm_gib=16),
+    DeviceCaps("v4", 275e12, 1228.0, hbm_gib=32),
+    DeviceCaps("v3", 123e12, 900.0, hbm_gib=16),
+    DeviceCaps("v2", 45e12, 700.0, hbm_gib=8),
 ]
 
 
@@ -81,7 +100,7 @@ def _cpu_caps():
         "cpu",
         get_env("MXTPU_PERF_CPU_PEAK_GFLOPS") * 1e9,
         get_env("MXTPU_PERF_CPU_GBPS"),
-        nominal=True)
+        nominal=True, hbm_gib=_CPU_NOMINAL_HBM >> 30)
 
 
 def caps_for_kind(kind):
@@ -108,6 +127,27 @@ def peak_flops(device, dtype="bfloat16"):
         if caps.kind in kind:
             return caps.peak(dtype)
     return None
+
+
+def hbm_capacity(device=None):
+    """Usable per-device HBM bytes for a jax device (or the default
+    backend when None): the ``MXTPU_HBM_BYTES`` override, else the
+    device generation's datasheet value, else the nominal CPU
+    capacity."""
+    if device is None:
+        import jax
+        device = jax.devices()[0]
+    return caps_for(device).capacity()
+
+
+def headroom(used_bytes, device=None, margin=None):
+    """Bytes of HBM still available after ``used_bytes``, holding
+    back ``margin`` (default ``MXTPU_MEM_GATE_MARGIN``) of capacity
+    for fragmentation/unmodeled scratch.  Negative = over budget."""
+    if margin is None:
+        margin = float(get_env("MXTPU_MEM_GATE_MARGIN"))
+    cap = hbm_capacity(device)
+    return cap * (1.0 - margin) - float(used_bytes)
 
 
 def roofline(flops, bytes_moved, caps, dtype="bfloat16"):

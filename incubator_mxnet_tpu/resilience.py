@@ -48,6 +48,8 @@ __all__ = ["ResilienceError", "TransientError", "DeadlineExceededError",
            "CollectiveAbortedError", "DataPipelineError",
            "CheckpointCorruptError", "BadStepError", "DivergedError",
            "ElasticRestartRequested", "ELASTIC_EXIT_CODE",
+           "MemoryPlanError", "OomError", "OOM_EXIT_CODE",
+           "is_oom", "check_oom", "as_oom_error",
            "NumericGuard", "install_diverged_exithook",
            "RetryPolicy", "retry_call",
            "deadline_call", "call_transient_mapped", "TRANSIENT_MARKERS",
@@ -187,6 +189,109 @@ class DataPipelineError(ResilienceError):
     def __init__(self, *args):
         super().__init__(*args)
         _flight_dump("data_pipeline_error")
+
+
+# tools/launch.py mirrors this by value too: a worker that dies on
+# device-memory exhaustion (predicted by the planner with the ladder
+# exhausted, or a real RESOURCE_EXHAUSTED past the one-rung retry)
+# exits distinctly from crashes (1), divergence (13), elastic (14)
+OOM_EXIT_CODE = 15
+
+
+class MemoryPlanError(ResilienceError):
+    """The preflight HBM gate predicts this step cannot fit and the
+    degrade ladder has no rungs left (docs/memory.md).
+
+    Raised BEFORE compiling, with the full per-category plan in the
+    message — the operator reads exactly what the planner thinks is
+    on the chip.  Constructing one dumps the flight recorder when
+    ``MXTPU_TRACE_DUMP`` is set (the ``mem_degrade`` rung events are
+    the post-mortem trail)."""
+
+    EXIT_CODE = OOM_EXIT_CODE
+
+    def __init__(self, site, plan=None, rungs=(), capacity=None):
+        self.site = site
+        self.plan = plan
+        self.rungs = list(rungs)
+        self.capacity = capacity
+        msg = f"memory plan overflow at {site}"
+        if capacity:
+            msg += f": capacity {capacity / (1 << 20):.1f}MB"
+        if plan is not None:
+            msg += f", predicted {plan.describe()}"
+        if self.rungs:
+            msg += f"; ladder exhausted after {self.rungs}"
+        else:
+            msg += "; no degrade rungs available"
+        msg += " (MXTPU_MEM_POLICY/MXTPU_HBM_BYTES/" \
+               "MXTPU_MEM_GATE_MARGIN control the gate)"
+        super().__init__(msg)
+        _flight_dump("memory_plan_error")
+
+
+class OomError(ResilienceError):
+    """Device memory actually ran out: a compile or execute raised
+    RESOURCE_EXHAUSTED (or the deterministic ``mem:oom`` injection
+    fired).  Typed so the one-rung runtime retry and the launcher can
+    tell OOM from a crash; carries the predicted-vs-actual plan when
+    the preflight planner ran.  Constructing one dumps the flight
+    recorder when ``MXTPU_TRACE_DUMP`` is set."""
+
+    EXIT_CODE = OOM_EXIT_CODE
+
+    def __init__(self, site, cause=None, plan=None):
+        self.site = site
+        self.plan = plan
+        msg = f"device out of memory at {site}"
+        if plan is not None:
+            msg += f" (planner predicted {plan.describe()})"
+        if cause is not None:
+            msg += f": {cause}"
+        super().__init__(msg)
+        _flight_dump("oom_error")
+
+
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory",
+                "out of memory", "Allocator ran out")
+
+
+def is_oom(exc):
+    """True when an exception is device-memory exhaustion: XLA's
+    RESOURCE_EXHAUSTED (XlaRuntimeError/RuntimeError text) or an
+    already-typed :class:`OomError`."""
+    if isinstance(exc, OomError):
+        return True
+    if isinstance(exc, MemoryPlanError):
+        # predicted overflow, nothing allocated: the runtime retry
+        # must not catch it (the ladder already ran dry)
+        return False
+    text = str(exc)
+    return any(m in text for m in _OOM_MARKERS)
+
+
+def check_oom(site):
+    """Deterministic ``mem:oom`` injection point: raise a synthetic
+    RESOURCE_EXHAUSTED at the nth guarded compile/step, so the whole
+    runtime OOM path (typed error, one ladder rung, single retry) is
+    testable on CPU.  Free when no fault spec is set (one env read);
+    never touches the device."""
+    if not faults_active():
+        return
+    if fault_for("mem", "oom") is not None:
+        raise RuntimeError(
+            f"RESOURCE_EXHAUSTED: injected mem:oom at {site} "
+            "(synthetic device allocation failure)")
+
+
+def as_oom_error(exc, site, plan=None):
+    """Route a caught compile/execute exception through the typed OOM
+    guard: returns an :class:`OomError` (post-mortem dump included)
+    when ``exc`` is memory exhaustion, None when it is anything else
+    — the caller must re-raise those, never swallow them."""
+    if not is_oom(exc):
+        return None
+    return OomError(site, cause=exc, plan=plan)
 
 
 # ---------------------------------------------------------------------------
@@ -465,6 +570,15 @@ def parse_fault_spec(raw):
             raise ValueError(
                 f"bad fault spec {entry!r}: kind 'spike' only "
                 "applies to the 'loss' scope")
+        if scope == "mem" and kind != "error":
+            # mem:oom models device allocation failure: the guarded
+            # compile/step sites (resilience.check_oom) raise a
+            # synthetic RESOURCE_EXHAUSTED — the only kind with a
+            # defined meaning there
+            raise ValueError(
+                f"bad fault spec {entry!r}: the 'mem' scope only "
+                "accepts kind 'error' (synthetic RESOURCE_EXHAUSTED "
+                "at the nth guarded compile/step)")
         if kind == "kill" and scope not in ("elastic", "router",
                                             "data_service"):
             # hard process death is a cross-process layer's test
@@ -746,7 +860,9 @@ def install_diverged_exithook():
     with ``DivergedError.EXIT_CODE`` (13) instead of the generic 1,
     so the launcher restart loop (tools/launch.py) can tell
     divergence — resume from the rolled-back checkpoint — from a
-    crash.
+    crash.  An uncaught :class:`OomError` / :class:`MemoryPlanError`
+    maps to :data:`OOM_EXIT_CODE` (15) the same way: restarting an
+    OOM without changing the memory levers just re-OOMs.
 
     Under elastic mode (``MXTPU_ELASTIC=1``, exported by
     ``tools/launch.py --elastic``) the hook additionally maps an
@@ -774,6 +890,11 @@ def install_diverged_exithook():
         code = None
         if isinstance(val, DivergedError):
             code = DivergedError.EXIT_CODE
+        elif isinstance(val, (OomError, MemoryPlanError)):
+            # device-memory exhaustion (runtime retry spent, or the
+            # preflight ladder ran dry): distinct exit so the
+            # launcher ledger separates OOM from crashes/divergence
+            code = OOM_EXIT_CODE
         elif isinstance(val, ElasticRestartRequested):
             code = ELASTIC_EXIT_CODE
         elif isinstance(val, CollectiveAbortedError) \

@@ -83,7 +83,10 @@ class ServingEngine:
     max_batch : concurrent decode slots (``MXTPU_SERVE_MAX_BATCH``)
     block_size : tokens per KV block (``MXTPU_SERVE_BLOCK_SIZE``)
     num_blocks : pool size incl. the reserved scratch block
-        (``MXTPU_SERVE_NUM_BLOCKS``)
+        (``MXTPU_SERVE_NUM_BLOCKS``); pass ``"auto"`` to size the
+        pool from memory-planner headroom — capacity minus weights
+        and decode workspace (docs/memory.md), refusing with a typed
+        error when the model alone cannot fit
     quantize : ``"off"`` or ``"int8"`` (``MXTPU_SERVE_QUANT``)
     prefix_cache : share prompt-prefix KV blocks across requests
         (``MXTPU_SERVE_PREFIX_CACHE``)
@@ -124,8 +127,13 @@ class ServingEngine:
         model._check_paged()
         self.block_size = int(block_size if block_size is not None
                               else get_env("MXTPU_SERVE_BLOCK_SIZE"))
-        self.num_blocks = int(num_blocks if num_blocks is not None
-                              else get_env("MXTPU_SERVE_NUM_BLOCKS"))
+        raw_blocks = num_blocks if num_blocks is not None \
+            else get_env("MXTPU_SERVE_NUM_BLOCKS")
+        # num_blocks="auto": size the pool from planner headroom
+        # (docs/memory.md) once the weights are settled below
+        self.auto_blocks = (isinstance(raw_blocks, str)
+                            and raw_blocks.lower() == "auto")
+        self.num_blocks = 0 if self.auto_blocks else int(raw_blocks)
         self.max_batch = int(max_batch if max_batch is not None
                              else get_env("MXTPU_SERVE_MAX_BATCH"))
         if self.block_size < 1 or self.max_batch < 1:
@@ -158,11 +166,11 @@ class ServingEngine:
         self.model = model
         # one table row spans the model's full context budget
         self.max_blocks = -(-model._max_len // self.block_size)
-        self.pool = BlockPool(self.num_blocks, self.block_size)
-        self.cache = PrefixCache(self.pool, enabled=prefix_cache)
         self._sched = Scheduler(self.max_batch)
         self.keep_logits = bool(keep_logits)
 
+        # weights settle BEFORE the pool: auto pool sizing needs the
+        # real (possibly quantized) weight bytes on the chip
         wts = self._settled_weights(model)
         if quantize in ("int8", True):
             self._wts = quantize_weights(wts)
@@ -177,6 +185,13 @@ class ServingEngine:
         import jax.numpy as jnp
         kvh = model.n_kv_heads
         dh = model._d // model.n_heads
+        if self.auto_blocks:
+            self.num_blocks = self._auto_num_blocks(kvh, dh)
+        if self.num_blocks < 1:
+            raise ValueError(
+                f"bad serving config: num_blocks={self.num_blocks}")
+        self.pool = BlockPool(self.num_blocks, self.block_size)
+        self.cache = PrefixCache(self.pool, enabled=prefix_cache)
         shape = (self.num_blocks, self.block_size, kvh, dh)
         self._kpools = [jnp.zeros(shape, jnp.float32)
                         for _ in range(model.n_layers)]
@@ -288,6 +303,52 @@ class ServingEngine:
         self._perf_caps = None
 
     # ---------------------------------------------------------- setup
+    def _auto_num_blocks(self, kvh, dh):
+        """Size the KV pool from planner headroom (docs/memory.md):
+        usable device capacity (MXTPU_HBM_BYTES override honored,
+        MXTPU_MEM_GATE_MARGIN reserved) minus the settled weights and
+        a per-step decode workspace (hidden states + logits), divided
+        by per-block KV bytes — capped at a full context row for
+        every slot plus the scratch block, so tiny models never hoard
+        the chip.  Refuses with a typed MemoryPlanError when the
+        model alone leaves no room for one block per slot."""
+        from ..perf import memory_planner as mp
+        from ..perf.device_db import headroom, hbm_capacity
+        wts_bytes = mp.tree_bytes(self._wts)
+        d = self.model._d
+        vocab = self.model.head._units
+        # decode workspace: one step's logits + residual stream per
+        # slot (fp32), the transient XLA scratch next to the pools
+        workspace = 4.0 * self.max_batch * (vocab + 8 * d)
+        per_block = 2.0 * self.model.n_layers * self.block_size \
+            * kvh * dh * 4
+        avail = headroom(wts_bytes + workspace)
+        floor = self.max_batch + 1   # one block per slot + scratch
+        if avail < per_block * floor:
+            from ..resilience import MemoryPlanError
+            plan = mp.MemoryPlan(
+                params=wts_bytes, activations=workspace,
+                kv_pool=per_block * floor,
+                meta={"site": "serving_engine",
+                      "num_blocks": floor,
+                      "quantized": self.quantized})
+            raise MemoryPlanError("serving_engine", plan,
+                                  capacity=hbm_capacity())
+        n = int(avail // per_block)
+        cap = self.max_batch * self.max_blocks + 1
+        n = min(n, cap)
+        plan = mp.MemoryPlan(
+            params=wts_bytes, activations=workspace,
+            kv_pool=per_block * n,
+            meta={"site": "serving_engine", "num_blocks": n,
+                  "quantized": self.quantized})
+        mp._publish_plan(plan)
+        import logging
+        logging.getLogger("mxtpu.memory").info(
+            "serving KV pool auto-sized: %d blocks (%s)", n,
+            plan.describe())
+        return n
+
     @staticmethod
     def _settled_weights(model):
         from ..gluon.parameter import DeferredInitializationError

@@ -542,6 +542,20 @@ _MEM_LOCK = threading.Lock()
 _MEM_PROVIDERS = {}     # kind -> {token: provider()->iterable arrays}
 _MEM_TOKEN = itertools.count()
 MEMORY_KINDS = ("params", "optimizer", "kv_pools")
+# latest preflight memory plan (predicted peak live bytes), set by
+# perf.memory_planner at bind/preflight time; the heartbeat gauges
+# publish predicted-minus-measured drift against it
+_MEM_PLAN = {"bytes": None}
+
+
+def set_memory_plan(predicted_bytes):
+    """Record the planner's latest predicted peak live bytes (None
+    clears).  Host-side state only — read by
+    :func:`update_memory_gauges` to publish
+    ``memory_plan_delta_bytes`` on the heartbeat cadence."""
+    with _MEM_LOCK:
+        _MEM_PLAN["bytes"] = None if predicted_bytes is None \
+            else float(predicted_bytes)
 
 
 def register_memory(kind, provider, owner=None):
@@ -708,6 +722,14 @@ def update_memory_gauges():
     if "device_peak_bytes" in stats:
         telemetry.gauge("device_peak_bytes").set(
             stats["device_peak_bytes"])
+    with _MEM_LOCK:
+        plan = _MEM_PLAN["bytes"]
+    if plan is not None and "device_live_bytes" in stats:
+        # planner drift: predicted peak minus measured live bytes
+        # (positive = planner conservative); metadata math only
+        delta = plan - stats["device_live_bytes"]
+        telemetry.gauge("memory_plan_delta_bytes").set(delta)
+        stats["memory_plan_delta_bytes"] = delta
     return stats
 
 
@@ -727,3 +749,4 @@ def reset_for_tests():
         _COMPILE_TOTALS.update(events=0, seconds=0.0, warn_at=None)
     with _MEM_LOCK:
         _MEM_PROVIDERS.clear()
+        _MEM_PLAN["bytes"] = None

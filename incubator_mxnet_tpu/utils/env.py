@@ -371,3 +371,23 @@ register_env("MXTPU_PERF_CPU_PEAK_GFLOPS", float, 100.0,
 register_env("MXTPU_PERF_CPU_GBPS", float, 25.0,
              "nominal CPU memory bandwidth (GB/s) for the device "
              "capability DB's roofline math on CPU-only hosts")
+
+# Memory planner + preflight OOM gate (docs/memory.md).
+register_env("MXTPU_MEM_POLICY", str, "degrade",
+             "memory-pressure policy for the preflight HBM gate and "
+             "the runtime OOM guard: off (never plan, raw XLA "
+             "RESOURCE_EXHAUSTED kills the job), warn (plan + log "
+             "overflow, never act), degrade (walk the ladder: "
+             "enable remat -> raise grad_accum -> typed "
+             "MemoryPlanError)")
+register_env("MXTPU_HBM_BYTES", float, 0.0,
+             "per-device HBM capacity override in bytes for "
+             "perf/device_db.py; 0 (default) uses the device "
+             "generation's known capacity (CPU hosts get a nominal "
+             "value tagged nominal_hbm=true); shrink it to exercise "
+             "the degrade ladder deterministically")
+register_env("MXTPU_MEM_GATE_MARGIN", float, 0.05,
+             "fraction of device HBM the preflight gate holds back "
+             "as safety margin (XLA fragmentation + unmodeled "
+             "scratch): a plan overflows when predicted peak > "
+             "(1 - margin) * capacity")

@@ -138,6 +138,15 @@ _EXTRACTORS = {
          lambda d: _get(d, ("serving", "tokens_per_s")),
          "tok/s", True),
     ],
+    "memory_pressure": [
+        ("memory_plan_max_abs_delta",
+         lambda d: _get(d, ("max_abs_rel_delta",)),
+         "rel", False),
+        ("memory_oom_recovery_s",
+         lambda d: (lambda ms: ms / 1e3 if ms is not None else None)(
+             _get(d, ("oom_recovery", "recovery_ms"))),
+         "s", False),
+    ],
 }
 
 

@@ -62,6 +62,12 @@ DIVERGED_EXIT = 13
 # checkpoint boundary) — with --elastic the restart ledger counts it
 # separately from crashes and divergence
 ELASTIC_EXIT = 14
+# resilience.OOM_EXIT_CODE, mirrored by value: a worker exits with
+# this after device memory exhaustion survived neither the preflight
+# degrade ladder nor the one-rung runtime retry (docs/memory.md) —
+# deterministic, so restarts are NOT elastic events and rarely help
+# unless capacity or batch size changed
+OOM_EXIT = 15
 
 
 def _free_port():
@@ -209,7 +215,10 @@ _ERROR_COUNTERS = ("retry_attempts_total", "collective_aborts_total",
                    # load shed at the door, deadlines blown, clients
                    # gone, engines draining for shutdown
                    "serving_rejected_total", "serving_expired_total",
-                   "serving_cancelled_total", "serving_drains_total")
+                   "serving_cancelled_total", "serving_drains_total",
+                   # memory-pressure survival (docs/memory.md):
+                   # preflight ladder rungs taken, runtime OOM retries
+                   "memory_plan_degrades_total", "oom_retries_total")
 
 
 def _read_heartbeat(path):
@@ -279,7 +288,8 @@ def _aggregate_telemetry(snaps):
            "compiles": {}, "max_memory": None, "data_img_s": 0.0,
            "data_img_s_by_rank": {}, "serve_queue": 0,
            "serve_queued_tokens": 0, "mfu_by_rank": {},
-           "mfu": None, "mfu_slowest": None}
+           "mfu": None, "mfu_slowest": None,
+           "plan_delta": {}, "plan_delta_worst": None}
     for rank, snap in snaps.items():
         for name, v in (snap.get("counters") or {}).items():
             agg["counters"][name] = agg["counters"].get(name, 0) + v
@@ -309,6 +319,11 @@ def _aggregate_telemetry(snaps):
         mem = _rank_memory(snap)
         if mem > 0:
             agg["memory"][rank] = mem
+        # memory planner drift (docs/memory.md): predicted minus
+        # measured live bytes, shipped per-beat by the tracing layer
+        delta = gauges.get("memory_plan_delta_bytes")
+        if delta is not None:
+            agg["plan_delta"][rank] = float(delta)
         compiles = (snap.get("counters") or {}).get(
             "compile_events_total", 0)
         if compiles:
@@ -321,6 +336,10 @@ def _aggregate_telemetry(snaps):
     if agg["memory"]:
         hi_rank = max(agg["memory"], key=agg["memory"].get)
         agg["max_memory"] = (hi_rank, agg["memory"][hi_rank])
+    if agg["plan_delta"]:
+        worst = max(agg["plan_delta"],
+                    key=lambda r: abs(agg["plan_delta"][r]))
+        agg["plan_delta_worst"] = (worst, agg["plan_delta"][worst])
     if agg["mfu_by_rank"]:
         vals = agg["mfu_by_rank"]
         agg["mfu"] = sum(vals.values()) / len(vals)
@@ -363,7 +382,13 @@ def _format_status(agg):
         parts.append(f"straggler: rank {rank} at step {at}/{hi}")
     if agg.get("max_memory") is not None:
         rank, mem = agg["max_memory"]
-        parts.append(f"mem: max rank {rank} at {_fmt_bytes(mem)}")
+        part = f"mem: max rank {rank} at {_fmt_bytes(mem)}"
+        if agg.get("plan_delta_worst") is not None:
+            drank, delta = agg["plan_delta_worst"]
+            sign = "+" if delta >= 0 else "-"
+            part += (f" (plan {sign}{_fmt_bytes(abs(delta))} "
+                     f"rank {drank})")
+        parts.append(part)
     if agg.get("compiles"):
         parts.append(
             f"compiles={sum(agg['compiles'].values())}")
@@ -406,6 +431,14 @@ def _format_report(snaps):
         rank, mem = agg["max_memory"]
         lines.append(f"launch.py:   max memory: rank {rank} at "
                      f"{_fmt_bytes(mem)}")
+    if agg.get("plan_delta_worst") is not None:
+        rank, delta = agg["plan_delta_worst"]
+        sign = "over-predicted by" if delta >= 0 \
+            else "UNDER-predicted by"
+        lines.append(
+            f"launch.py:   memory plan drift: rank {rank} "
+            f"{sign} {_fmt_bytes(abs(delta))} (predicted minus "
+            "measured live; docs/memory.md)")
     if agg.get("serve_queue", 0) > 0:
         lines.append(
             f"launch.py:   serving queue at exit: "
@@ -1243,7 +1276,7 @@ def main():
                 args.status_interval, data_fleet=data_fleet)
             if rc == 0:
                 break
-            if args.elastic and rc != DIVERGED_EXIT:
+            if args.elastic and rc not in (DIVERGED_EXIT, OOM_EXIT):
                 if elastic_restarts >= args.max_elastic_restarts:
                     print("launch.py: elastic restart budget spent "
                           f"({elastic_restarts}/"
@@ -1281,7 +1314,20 @@ def main():
                 if crash_restarts >= args.max_restarts:
                     break
                 crash_restarts += 1
-                if rc == DIVERGED_EXIT:
+                if rc == OOM_EXIT:
+                    print(f"launch.py: worker reported OUT OF "
+                          f"MEMORY (exit {rc}): device HBM "
+                          "exhausted past the preflight ladder and "
+                          "the one-rung runtime retry; the flight-"
+                          "recorder post-mortem carries the "
+                          "predicted-vs-actual memory plan "
+                          "(docs/memory.md).  OOM is deterministic "
+                          "— restarting (attempt "
+                          f"{crash_restarts}/{args.max_restarts}) "
+                          "rarely helps unless batch size, model, "
+                          "or MXTPU_HBM_BYTES changed",
+                          file=sys.stderr)
+                elif rc == DIVERGED_EXIT:
                     print(f"launch.py: worker reported DIVERGENCE "
                           f"(exit {rc}: MXTPU_MAX_BAD_STEPS "
                           "consecutive non-finite steps); params "
