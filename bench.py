@@ -1750,6 +1750,184 @@ def _bench_tracing(dev, platform):
     }))
 
 
+def _bench_debugz(dev, platform):
+    """Live introspection bench (ISSUE 20 acceptance, BENCH_r20.json):
+    (a) serving throughput with the debugz endpoint disabled
+    (MXTPU_DEBUGZ=0) vs enabled AND actively polled (a client thread
+    cycling varz/statusz/healthz against the live endpoint during
+    the measured pass) — the endpoint must cost < 2%; (b) the online
+    AnomalyWatch fed a synthetic per-step timeline with a 3x
+    ``data_wait`` regression injected — detected within 20 steps,
+    attributed to the right component, exactly one episode.
+    CPU-measurable; run with MXTPU_BENCH_MODEL=debugz."""
+    import random
+    import threading
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import debugz, rpc, telemetry
+    from incubator_mxnet_tpu.gluon.model_zoo.transformer import \
+        TransformerLM
+    from incubator_mxnet_tpu.serving import ServingEngine
+
+    del dev
+    mx.random.seed(0)
+    rs = np.random.RandomState(7)
+    vocab, d, layers, heads, max_len = 512, 256, 4, 8, 128
+    n_req = int(os.environ.get("MXTPU_BENCH_SERVE_REQS", "16"))
+    max_new = int(os.environ.get("MXTPU_BENCH_SERVE_NEW", "32"))
+    _stage(f"building LM d={d} L={layers} ({n_req} requests x "
+           f"{max_new} new tokens)", tag="debugz")
+    net = TransformerLM(vocab, d_model=d, n_layers=layers,
+                        n_heads=heads, max_len=max_len)
+    net.initialize(mx.init.Xavier())
+    prompts = []
+    for _ in range(n_req):
+        own = list(rs.randint(0, vocab, int(rs.randint(8, 40))))
+        prompts.append(own[:max_len - max_new - 1])
+    ntok = n_req * max_new
+
+    def measured(poll_addr=None):
+        """Compile-warm + cache-warm passes, then best-of-3 measured
+        saturated passes; when ``poll_addr`` is set, a client thread
+        hammers the live endpoint throughout the measured passes."""
+        eng = ServingEngine(net, max_batch=8, block_size=16,
+                            num_blocks=192)
+        unreg = debugz.register_provider(
+            "engine", lambda: {"stats_requests":
+                               len(eng.stats()["requests"])}) \
+            if poll_addr else None
+
+        def one_pass():
+            t0 = time.perf_counter()
+            for p in prompts:
+                eng.submit(p, max_new)
+            eng.run()
+            return time.perf_counter() - t0
+
+        one_pass()      # compiles prefill buckets + decode step
+        one_pass()      # warm prefix cache's smaller buckets
+        stop = threading.Event()
+        polls = [0]
+
+        def poller():
+            ops = ({"op": "varz"}, {"op": "statusz"},
+                   {"op": "healthz"})
+            i = 0
+            while not stop.wait(0.02):
+                try:
+                    rpc.call_once(poll_addr[0], poll_addr[1],
+                                  ops[i % 3], timeout=2.0)
+                    polls[0] += 1
+                except rpc.RpcError:
+                    pass
+                i += 1
+
+        t = None
+        if poll_addr is not None:
+            t = threading.Thread(target=poller, daemon=True)
+            t.start()
+        try:
+            best = min(one_pass() for _ in range(3))
+        finally:
+            stop.set()
+            if t is not None:
+                t.join(timeout=5)
+            if unreg is not None:
+                unreg()
+        return best, polls[0]
+
+    prev_dz = os.environ.get("MXTPU_DEBUGZ")
+    try:
+        os.environ["MXTPU_DEBUGZ"] = "0"
+        debugz.stop()
+        _stage("serving pass, endpoint OFF (MXTPU_DEBUGZ=0)",
+               tag="debugz")
+        off_s, _ = measured()
+        os.environ["MXTPU_DEBUGZ"] = "1"
+        srv = debugz.maybe_start("bench")
+        _stage(f"serving pass, endpoint ON + polled "
+               f"(port {srv.port})", tag="debugz")
+        on_s, n_polls = measured(poll_addr=(srv.host, srv.port))
+    finally:
+        if prev_dz is None:
+            os.environ.pop("MXTPU_DEBUGZ", None)
+        else:
+            os.environ["MXTPU_DEBUGZ"] = prev_dz
+        debugz.stop()
+    overhead = (on_s - off_s) / off_s
+    _stage(f"debugz overhead {overhead * 100:.2f}% "
+           f"({ntok / off_s:.0f} -> {ntok / on_s:.0f} tok/s, "
+           f"{n_polls} polls during measured passes)", tag="debugz")
+
+    # ---- anomaly watchdog: injected 3x data_wait regression -----
+    _stage("anomaly watchdog: inject 3x data_wait at step 33",
+           tag="debugz")
+    telemetry.reset_anomaly_for_tests()
+    rnd = random.Random(3)
+    baseline = {"data_wait": 0.010, "forward_backward": 0.030,
+                "optimizer": 0.005, "host_sync": 0.002}
+
+    def split(scale):
+        return {k: v * (scale if k == "data_wait" else 1.0)
+                * (1.0 + 0.02 * rnd.random())
+                for k, v in baseline.items()}
+
+    watch = telemetry.AnomalyWatch(group="bench", window=32,
+                                   threshold=6.0, min_samples=8,
+                                   cooldown=4)
+    for _ in range(32):
+        watch.observe(split(1.0))
+    detect_steps, component = None, None
+    for step in range(1, 21):
+        ep = watch.observe(split(3.0))
+        if ep is not None:
+            detect_steps, component = step, ep["component"]
+            break
+    for _ in range(40):         # sustained: still one episode
+        watch.observe(split(3.0))
+    _stage(f"anomaly detected in {detect_steps} step(s), "
+           f"component={component}, episodes={watch.episodes}",
+           tag="debugz")
+
+    artifact = {
+        "metric": "debugz_introspection",
+        "platform": platform,
+        "stream": {"requests": n_req, "max_new_tokens": max_new},
+        "throughput": {
+            "tokens_per_s_debugz_off": round(ntok / off_s, 1),
+            "tokens_per_s_debugz_on": round(ntok / on_s, 1),
+            "overhead_pct": round(overhead * 100, 2),
+            "overhead_under_2pct": overhead < 0.02,
+            "polls_during_measured_passes": n_polls},
+        "anomaly": {
+            "injected": "data_wait x3 after 32 calm steps",
+            "detect_steps": detect_steps,
+            "detected_within_20_steps":
+                detect_steps is not None and detect_steps <= 20,
+            "component": component,
+            "attributed_correctly": component == "data_wait",
+            "episodes": watch.episodes,
+            "exactly_one_episode": watch.episodes == 1},
+        "endpoint_ops": list(debugz.OPS),
+    }
+    out_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_r20.json")
+    with open(out_path, "w") as f:
+        f.write(json.dumps(artifact, indent=2) + "\n")
+    print(json.dumps({
+        "metric": "debugz_introspection",
+        "value": artifact["throughput"]["overhead_pct"],
+        "unit": "pct_overhead_vs_debugz_off",
+        "platform": platform,
+        "tokens_per_s_on": artifact["throughput"][
+            "tokens_per_s_debugz_on"],
+        "anomaly_detect_steps": detect_steps,
+        "anomaly_component": component,
+        "anomaly_exactly_one_episode": watch.episodes == 1,
+        "artifact": "BENCH_r20.json",
+    }))
+
+
 def _make_synthetic_rec(path_prefix, n, edge=224):
     """Write n real JPEGs (structured noise) into an indexed .rec."""
     import io as _pyio
@@ -2377,6 +2555,9 @@ def main():
         return
     if os.environ.get("MXTPU_BENCH_MODEL") == "memory":
         _bench_memory(dev, platform)
+        return
+    if os.environ.get("MXTPU_BENCH_MODEL") == "debugz":
+        _bench_debugz(dev, platform)
         return
 
     import incubator_mxnet_tpu as mx

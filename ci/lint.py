@@ -85,6 +85,11 @@ HOT_SYNC_FILES = (
     # the serving publisher runs inside the decode loop — both are
     # wall-clock-only by contract (docs/observability.md)
     "incubator_mxnet_tpu/perf/clock.py",
+    # introspection plane: every debugz op is zero-device-sync by
+    # contract — a varz/statusz poll against a busy rank must never
+    # stall the step or decode loop (docs/observability.md
+    # "Introspection plane")
+    "incubator_mxnet_tpu/debugz.py",
 )
 HOT_SYNC_FUNCS = {"step", "update", "__call__", "begin_step",
                   "guarded_step_begin", "read_window_bad",
@@ -104,7 +109,15 @@ HOT_SYNC_FUNCS = {"step", "update", "__call__", "begin_step",
                   "update_memory_gauges", "_rss_bytes",
                   # perf observatory (MFU gauges must stay
                   # wall-clock-only; docs/observability.md)
-                  "tick", "_publish_perf"}
+                  "tick", "_publish_perf",
+                  # debugz op handlers + dispatch + provider fan-in:
+                  # the whole introspection read path is host-side
+                  "_handle", "_status_payload", "_op_varz",
+                  "_op_statusz", "_op_tracez", "_op_memz",
+                  "_op_profilez", "_op_healthz",
+                  # anomaly watchdog: fed on every train step and
+                  # every emitted serving token
+                  "observe", "verdicts"}
 # attrs that always sync, and ones that sync only for specific roots
 SYNC_ATTRS = {"item", "asscalar", "asnumpy"}
 SYNC_ROOT_ATTRS = {("np", "asarray"), ("numpy", "asarray"),
@@ -127,6 +140,10 @@ SOCKET_WAIT_FILES = (
     # remote data-service ranks: a dead train host must never park a
     # shard server's stream thread (and vice versa)
     "incubator_mxnet_tpu/data_service/net.py",
+    # introspection plane: the endpoint and its stdlib fleet client
+    # both promise a hung peer can never hang the caller
+    "incubator_mxnet_tpu/debugz.py",
+    "tools/debugz.py",
 )
 SOCKET_WAIT_ATTRS = {"recv", "accept", "connect",
                      "create_connection"}
@@ -147,6 +164,9 @@ MONO_CLOCK_PATHS = (
     # deadline arithmetic too (moved out of serving/, keep covered)
     "incubator_mxnet_tpu/rpc.py",
     "incubator_mxnet_tpu/data_service/net.py",
+    # introspection plane: per-target deadlines everywhere
+    "incubator_mxnet_tpu/debugz.py",
+    "tools/debugz.py",
 )
 
 # MXTPU_-prefixed tokens that are NOT environment variables (log
@@ -880,6 +900,67 @@ def check_metric_catalog(files):
     return sorted(set(problems))
 
 
+# anomaly watchdog names (docs/observability.md "Introspection
+# plane"): the counter and the trace event the episode contract
+# promises — both must stay catalogued
+DEBUGZ_ANOMALY_METRICS = ("anomaly_detections_total",)
+DEBUGZ_ANOMALY_EVENTS = ("anomaly",)
+
+
+def check_debugz_catalog(files):
+    """Every debugz op name — the ``OPS`` tuple in debugz.py (and
+    its mirror in tools/debugz.py) — and every anomaly-watchdog
+    metric/event must appear (backtick-quoted) in
+    docs/observability.md: an operator querying a live process must
+    always find the op's reply contract documented."""
+    docs = Path("docs/observability.md")
+    if not docs.exists():
+        return []
+    catalog = docs.read_text()
+    problems = []
+    saw_debugz = False
+    for path in files:
+        posix = path.as_posix()
+        # substring match so tmp-dir test copies trigger the rule
+        if "debugz" not in path.name:
+            continue
+        saw_debugz = True
+        try:
+            tree = ast.parse(path.read_text())
+        except SyntaxError:
+            continue        # reported by check_file
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == "OPS"
+                    and isinstance(node.value, (ast.Tuple,
+                                                ast.List))):
+                continue
+            for elt in node.value.elts:
+                if not (isinstance(elt, ast.Constant)
+                        and isinstance(elt.value, str)):
+                    continue
+                if f"`{elt.value}`" not in catalog:
+                    problems.append(
+                        f"{posix}:{elt.lineno}: debugz op "
+                        f"{elt.value!r} is not documented in the "
+                        "Introspection plane catalog of "
+                        "docs/observability.md")
+    if saw_debugz:
+        for name in DEBUGZ_ANOMALY_METRICS:
+            if f"`{name}`" not in catalog:
+                problems.append(
+                    f"docs/observability.md: anomaly metric "
+                    f"{name!r} missing from the metric catalog")
+        for name in DEBUGZ_ANOMALY_EVENTS:
+            if f"`{name}`" not in catalog:
+                problems.append(
+                    f"docs/observability.md: anomaly event "
+                    f"{name!r} missing from the event catalog")
+    return sorted(set(problems))
+
+
 def main(argv):
     roots = argv or DEFAULT_PATHS
     files = []
@@ -894,6 +975,7 @@ def main(argv):
         problems.extend(check_file(f))
     problems.extend(check_env_vars(files))
     problems.extend(check_metric_catalog(files))
+    problems.extend(check_debugz_catalog(files))
     problems.extend(check_fault_scopes(files))
     problems.extend(check_op_cost_coverage(files))
     for p in problems:

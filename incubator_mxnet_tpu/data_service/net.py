@@ -45,7 +45,7 @@ import time
 
 import numpy as np
 
-from .. import resilience, telemetry
+from .. import debugz, resilience, telemetry
 from ..resilience import DataPipelineError
 from ..rpc import (RpcClient, RpcError, RpcServer, RpcTimeoutError,
                    default_timeout)
@@ -424,8 +424,27 @@ class RemoteShardServer:
         then park until :meth:`request_stop`."""
         resilience.start_heartbeat()
         self.start()
+        # live introspection: shard cursors + ring state per active
+        # stream (host-side bookkeeping under the server lock)
+        debugz.maybe_start("data")
+        debugz.register_provider("shards", self._debug_status)
         while not self._stop.is_set():
             self._stop.wait(timeout=_POLL_S)
+
+    def _debug_status(self):
+        with self._lock:
+            items = list(self._streams.items())
+        out = {}
+        for (cid, shard), st in items:
+            out[f"conn{cid}:shard{shard}"] = {
+                "shard": st.shard,
+                "epoch_imgs": st._epoch_imgs,
+                "epoch_elapsed_s": round(
+                    time.monotonic() - st._epoch_t0, 3),
+                "clean": st._clean,
+                "ring": st._ring is not None,
+            }
+        return out
 
     def request_stop(self):
         self._stop.set()

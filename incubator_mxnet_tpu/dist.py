@@ -81,6 +81,10 @@ def init(coordinator_address=None, num_workers_=None, rank_=None):
     # launcher-spawned workers report divergence with a distinct exit
     # code so launch.py's restart loop can tell it from a crash
     resilience.install_diverged_exithook()
+    # live introspection endpoint (debugz): up before the jax join so
+    # a rank wedged *in* the join can still answer varz/healthz
+    from . import debugz
+    debugz.maybe_start("train")
     import jax
     if _initialized:
         return jax.process_index()

@@ -6,6 +6,7 @@ predict).
 import logging
 import time
 
+from .. import debugz
 from .. import initializer as init_mod
 from .. import metric as metric_mod
 from .. import telemetry
@@ -214,20 +215,34 @@ class BaseModule:
             # time wall-clock sections only — no device reads beyond
             # what the section already performs (update_metric's
             # host pull, the sentinel's guard-interval read), so the
-            # transfer budget is unchanged.
+            # transfer budget is unchanged.  The captured elapsed
+            # times additionally feed the online anomaly watchdog
+            # and the debugz statusz publish (host-side floats).
+            watch = telemetry.anomaly_watch("train")
             while True:
-                with telemetry.span("data_wait"):
+                sp_data = telemetry.span("data_wait")
+                with sp_data:
                     data_batch = next(data_iter, None)
                 if data_batch is None:
                     break
                 if monitor is not None:
                     monitor.tic()
-                with telemetry.span("forward_backward"):
+                sp_fb = telemetry.span("forward_backward")
+                with sp_fb:
                     self.forward_backward(data_batch)
-                with telemetry.span("optimizer"):
+                sp_opt = telemetry.span("optimizer")
+                with sp_opt:
                     self.update()
-                with telemetry.span("host_sync"):
+                sp_sync = telemetry.span("host_sync")
+                with sp_sync:
                     self.update_metric(eval_metric, data_batch.label)
+                split = {"data_wait": sp_data.elapsed,
+                         "forward_backward": sp_fb.elapsed,
+                         "optimizer": sp_opt.elapsed,
+                         "host_sync": sp_sync.elapsed}
+                watch.observe(split)
+                debugz.publish("train", step=nbatch, epoch=epoch,
+                               split=split)
                 if monitor is not None:
                     monitor.toc_print()
                 if batch_end_callback is not None:

@@ -1158,7 +1158,20 @@ def validate_or_raise(path):
 # ---------------------------------------------------------------------------
 
 _HB_LOCK = threading.Lock()
-_HB_STATE = {"thread": None, "stop": None, "path": None}
+_HB_STATE = {"thread": None, "stop": None, "path": None,
+             "last_beat": None}
+
+
+def heartbeat_age():
+    """Seconds since this process last wrote its own heartbeat, or
+    None when the beat never fired (disabled / not started).  Local
+    monotonic bookkeeping — debugz ``healthz`` serves it without
+    touching the heartbeat file."""
+    with _HB_LOCK:
+        last = _HB_STATE["last_beat"]
+    if last is None:
+        return None
+    return time.monotonic() - last
 
 
 def _beat(path):
@@ -1180,6 +1193,8 @@ def _beat(path):
     except Exception:
         pass
     _replace_with_bytes(path, payload.encode(), sync_dir=False)
+    with _HB_LOCK:
+        _HB_STATE["last_beat"] = time.monotonic()
 
 
 def start_heartbeat(path=None, interval=None):

@@ -335,6 +335,22 @@ class RpcClient:
                 pass
 
 
+def call_once(host, port, msg, timeout=None):
+    """Connect, send one frame, wait for one reply, close — under a
+    single monotonic deadline covering all three phases.  The
+    one-shot shape debugz/status pollers need: a SIGSTOPped peer
+    costs at most ``timeout`` seconds, never a wedged caller."""
+    t = default_timeout() if timeout is None else float(timeout)
+    deadline = time.monotonic() + t
+    cli = RpcClient(host, port, timeout=t, fault_scope=None)
+    try:
+        cli.connect(timeout=_remaining(deadline, "call_once connect"))
+        return cli.call(msg, timeout=_remaining(deadline,
+                                                "call_once reply"))
+    finally:
+        cli.close()
+
+
 class _Conn:
     """Server-side handle for one accepted connection."""
 

@@ -1338,6 +1338,9 @@ class ServingEngine:
         if req.first_token_ts is None:
             req.first_token_ts = now
             self._h_ttft.observe(now - req.submit_ts)
+            # serving-side anomaly watchdog: TTFT drift (host floats)
+            telemetry.anomaly_watch("serving").observe(
+                {"ttft": now - req.submit_ts})
             tracing.trace_event(
                 "serve_first_token", rid=req.id,
                 engine=self.engine_id,
@@ -1346,6 +1349,8 @@ class ServingEngine:
                 prefill_s=round(req.prefill_s, 6))
         else:
             self._h_tok.observe(now - req.last_token_ts)
+            telemetry.anomaly_watch("serving").observe(
+                {"token_latency": now - req.last_token_ts})
         req.last_token_ts = now
         req.generated.append(tok)
         self._m_tokens.inc()

@@ -48,7 +48,7 @@ import threading
 import time
 from collections import deque
 
-from .. import resilience, telemetry, tracing
+from .. import debugz, resilience, telemetry, tracing
 from ..utils.env import get_env
 from ..utils.log import get_logger
 from . import rpc
@@ -225,6 +225,17 @@ class ReplicaServer:
             self.eng.install_sigterm(self.snapshot_path, drain=True)
         self._srv.start()
         eng = self.eng
+        # live introspection: statusz serves engine stats + scheduler
+        # depth (host-side counters only — no step-loop interference)
+        debugz.maybe_start("replica")
+        unregister = debugz.register_provider(
+            "engine", lambda: {
+                "name": self.name,
+                "stats": eng.stats(),
+                "queue_depth": len(eng._sched.waiting),
+                "running": eng._sched.n_running(),
+                "draining": eng._draining,
+            })
         try:
             while not self._stop.is_set():
                 busy = False
@@ -253,6 +264,7 @@ class ReplicaServer:
                 if not busy:
                     time.sleep(self._poll)
         finally:
+            unregister()
             self._srv.close()
             resilience.stop_heartbeat()
 

@@ -39,7 +39,7 @@ import os
 import threading
 import time
 
-from .. import telemetry, tracing
+from .. import debugz, telemetry, tracing
 from ..utils.env import get_env
 from ..utils.log import get_logger
 from . import rpc
@@ -867,6 +867,10 @@ class ServingRouter:
                                        port=port,
                                        name="router-frontend")
         self._frontend.start()
+        # live introspection: router statusz mirrors the stats op
+        # (same host-side snapshot, no request-path involvement)
+        debugz.maybe_start("router")
+        debugz.register_provider("router", self.stats)
         if poll_in_background:
             def _poll_loop():
                 while not self._closed.is_set():

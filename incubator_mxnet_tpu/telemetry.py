@@ -42,6 +42,7 @@ declared in the catalog table of docs/observability.md — enforced by
 """
 import json
 import os
+import re
 import threading
 import time
 from collections import deque
@@ -49,10 +50,11 @@ from collections import deque
 from .utils.env import get_env
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricRegistry",
-           "TelemetryEmitter", "enabled", "get_registry", "counter",
-           "gauge", "histogram", "span", "snapshot",
-           "prometheus_text", "heartbeat_payload", "start_emitter",
-           "maybe_start_emitter", "stop_emitter"]
+           "TelemetryEmitter", "AnomalyWatch", "enabled",
+           "get_registry", "counter", "gauge", "histogram", "span",
+           "snapshot", "prometheus_text", "heartbeat_payload",
+           "start_emitter", "maybe_start_emitter", "stop_emitter",
+           "anomaly_watch", "anomaly_verdicts"]
 
 
 def enabled():
@@ -245,21 +247,71 @@ class MetricRegistry:
                 "histograms": hists}
 
     def prometheus_text(self, prefix="mxtpu_"):
-        """Prometheus exposition-format text of the current state
-        (counters/gauges as-is, histograms as summary _count/_sum)."""
+        """Prometheus exposition-format text of the current state:
+        counters/gauges as-is, histograms as summary ``_count``/
+        ``_sum`` plus ``_p50``/``_p99`` quantile gauges.  Every
+        metric carries ``# TYPE`` and (where the docs catalog knows
+        it) ``# HELP`` — the help text comes from the same
+        docs/observability.md tables ci/lint.py already enforces, so
+        the exposition and the catalog cannot drift apart."""
         snap = self.snapshot()
         lines = []
+
+        def head(name, kind):
+            lines.append(f"# TYPE {prefix}{name} {kind}")
+            doc = _metric_help(name)
+            if doc:
+                lines.append(f"# HELP {prefix}{name} {doc}")
+
         for name, v in sorted(snap["counters"].items()):
-            lines.append(f"# TYPE {prefix}{name} counter")
+            head(name, "counter")
             lines.append(f"{prefix}{name} {v}")
         for name, v in sorted(snap["gauges"].items()):
-            lines.append(f"# TYPE {prefix}{name} gauge")
+            head(name, "gauge")
             lines.append(f"{prefix}{name} {v}")
         for name, st in sorted(snap["histograms"].items()):
-            lines.append(f"# TYPE {prefix}{name} summary")
+            head(name, "summary")
             lines.append(f"{prefix}{name}_count {st['count']}")
             lines.append(f"{prefix}{name}_sum {st['sum']}")
+            for q in ("p50", "p99"):
+                if st.get(q) is not None:
+                    head(f"{name}_{q}", "gauge")
+                    lines.append(f"{prefix}{name}_{q} {st[q]}")
         return "\n".join(lines) + "\n"
+
+
+_HELP_CACHE = {"loaded": False, "help": {}}
+
+
+def _metric_help(name):
+    """Help text for one metric, parsed (once, lazily) from the
+    docs/observability.md catalog tables — the single source of
+    truth the lint rules enforce metric names against.  Returns None
+    when the docs are absent (installed without docs) or the name is
+    a derived one (``_p50``/``_p99`` quantiles inherit nothing)."""
+    if not _HELP_CACHE["loaded"]:
+        _HELP_CACHE["loaded"] = True
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "docs", "observability.md")
+        try:
+            with open(path, encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line.startswith("|") or "`" not in line:
+                        continue
+                    cells = [c.strip() for c in
+                             line.strip("|").split("|")]
+                    if len(cells) < 3:
+                        continue
+                    names = re.findall(r"`([^`]+)`", cells[0])
+                    text = " ".join(cells[-1].replace("`", "")
+                                    .split())
+                    for n in names:
+                        _HELP_CACHE["help"].setdefault(n, text)
+        except OSError:
+            pass
+    return _HELP_CACHE["help"].get(name)
 
 
 _REGISTRY = MetricRegistry()
@@ -305,6 +357,7 @@ class _NullSpan:
     """No-op span: the disabled-mode (and re-enterable) singleton."""
 
     __slots__ = ()
+    elapsed = 0.0
 
     def __enter__(self):
         return self
@@ -321,13 +374,16 @@ class _Span:
     ``span_<name>_seconds`` and, when the profiler is running, into
     its chrome://tracing stream (category 'span') so step phases and
     per-op events share a timeline.  Host-side timing only — never
-    reads a device value."""
+    reads a device value.  The last measured duration stays readable
+    as ``.elapsed`` so a fit loop can feed the per-step timeline
+    splits to :class:`AnomalyWatch` without re-timing anything."""
 
-    __slots__ = ("name", "_t0")
+    __slots__ = ("name", "_t0", "elapsed")
 
     def __init__(self, name):
         self.name = name
         self._t0 = None
+        self.elapsed = 0.0
 
     def __enter__(self):
         self._t0 = time.perf_counter()
@@ -337,8 +393,9 @@ class _Span:
         if self._t0 is None:
             return False
         t1 = time.perf_counter()
+        self.elapsed = t1 - self._t0
         _REGISTRY.histogram(
-            f"span_{self.name}_seconds").observe(t1 - self._t0)
+            f"span_{self.name}_seconds").observe(self.elapsed)
         prof = _profiler()
         if prof is not None and prof.running:
             prof.add_event(self.name, self._t0, t1, category="span")
@@ -394,6 +451,7 @@ class TelemetryEmitter:
         self._stop = threading.Event()
         self._thread = None
         self._flush_lock = threading.Lock()
+        self._atexit = False
 
     @property
     def running(self):
@@ -401,9 +459,19 @@ class TelemetryEmitter:
 
     def start(self):
         """Spawn the flusher daemon (no-op without a path or when
-        telemetry is disabled); returns self."""
+        telemetry is disabled); returns self.  Registers an atexit
+        final flush for THIS emitter: a directly-constructed emitter
+        on a short-lived process (bench run, spawned worker) would
+        otherwise lose the last partial interval — the daemon thread
+        dies with the interpreter mid-wait, never flushing.
+        ``stop()`` is idempotent, so an emitter stopped explicitly
+        just re-flushes a final complete record at exit."""
         if self.path is None or not enabled() or self.running:
             return self
+        if not self._atexit:
+            import atexit
+            atexit.register(self.stop)
+            self._atexit = True
         self._stop.clear()
 
         def loop():
@@ -544,6 +612,176 @@ def stop_emitter():
         em, _EMITTER["obj"] = _EMITTER["obj"], None
     if em is not None:
         em.stop()
+
+
+# ---------------------------------------------------------------------------
+# online anomaly watchdog
+# ---------------------------------------------------------------------------
+
+
+def _median(data):
+    """Median of a pre-sorted list."""
+    n = len(data)
+    mid = n // 2
+    if n % 2:
+        return data[mid]
+    return 0.5 * (data[mid - 1] + data[mid])
+
+
+class AnomalyWatch:
+    """Online regression detector over per-step timeline splits and
+    serving latencies (docs/observability.md "Introspection plane").
+
+    Each component (``data_wait`` / ``forward_backward`` /
+    ``optimizer`` / ``host_sync``, or serving ``ttft`` /
+    ``token_latency``) keeps a rolling window
+    (``MXTPU_ANOMALY_WINDOW``) whose median + MAD form the baseline;
+    an observation scoring above ``MXTPU_ANOMALY_THRESHOLD`` MADs
+    over the median — after ``MXTPU_ANOMALY_MIN_STEPS`` warmup
+    samples — opens an **episode**, attributed to the dominant
+    drifting component.  Exactly one ``anomaly`` trace event and one
+    ``anomaly_detections_total`` increment fire per episode;
+    hysteresis (``MXTPU_ANOMALY_COOLDOWN`` consecutive calm samples
+    to close) keeps a sustained regression from flapping.  Because
+    regressed samples still enter the window, a *permanent* shift
+    eventually becomes the new baseline and the episode closes on
+    its own — the watchdog flags changes, it does not alarm forever.
+
+    Everything is host-side float arithmetic under one short lock —
+    zero device syncs, safe on the step/decode path."""
+
+    def __init__(self, group="train", window=None, threshold=None,
+                 min_samples=None, cooldown=None):
+        self.group = group
+        self.window = int(window if window is not None
+                          else get_env("MXTPU_ANOMALY_WINDOW"))
+        self.threshold = float(
+            threshold if threshold is not None
+            else get_env("MXTPU_ANOMALY_THRESHOLD"))
+        self.min_samples = int(
+            min_samples if min_samples is not None
+            else get_env("MXTPU_ANOMALY_MIN_STEPS"))
+        self.cooldown = int(cooldown if cooldown is not None
+                            else get_env("MXTPU_ANOMALY_COOLDOWN"))
+        self.episodes = 0
+        self._hist = {}         # component -> deque(maxlen=window)
+        self._seen = {}         # component -> total samples fed
+        self._open = None       # episode dict while one is open
+        self._calm = 0          # consecutive calm samples while open
+        self._last_scores = {}
+        self._lock = threading.Lock()
+
+    def observe(self, sample):
+        """Feed one observation (``{component: seconds}``; partial
+        dicts fine — serving feeds ``ttft`` and ``token_latency`` on
+        different calls).  Returns the episode dict when this sample
+        OPENED one (the caller already got its single emission),
+        else None."""
+        if not enabled():
+            return None
+        scores = {}
+        with self._lock:
+            for comp, v in sample.items():
+                v = float(v)
+                hist = self._hist.get(comp)
+                if hist is None:
+                    hist = self._hist[comp] = deque(
+                        maxlen=self.window)
+                seen = self._seen.get(comp, 0)
+                if seen >= self.min_samples and len(hist) >= 2:
+                    data = sorted(hist)
+                    med = _median(data)
+                    mad = _median(sorted(abs(x - med)
+                                         for x in data))
+                    # noise floor: a near-flat baseline must not
+                    # turn scheduler jitter into infinite scores
+                    floor = max(mad, 0.05 * abs(med), 1e-9)
+                    scores[comp] = ((v - med) / floor, v, med)
+                hist.append(v)
+                self._seen[comp] = seen + 1
+            episode = self._step_episode(scores)
+        if episode is not None:
+            counter("anomaly_detections_total").inc()
+            from . import tracing
+            tracing.trace_event(
+                "anomaly", group=self.group,
+                component=episode["component"],
+                score=episode["score"], value=episode["value"],
+                median=episode["median"],
+                episode=episode["episode"])
+        return episode
+
+    def _step_episode(self, scores):
+        """Episode state machine (caller holds the lock).  Returns a
+        copy of the episode dict exactly when one newly opens."""
+        self._last_scores = {c: round(s[0], 3)
+                             for c, s in scores.items()}
+        hot = {c: s for c, s in scores.items()
+               if s[0] >= self.threshold}
+        if self._open is None:
+            if not hot:
+                return None
+            comp = max(hot, key=lambda c: hot[c][0])
+            score, value, med = hot[comp]
+            self.episodes += 1
+            self._calm = 0
+            self._open = {"component": comp,
+                          "score": round(score, 3), "value": value,
+                          "median": med, "episode": self.episodes,
+                          "samples": 1}
+            return dict(self._open)
+        self._open["samples"] += 1
+        if hot:
+            self._calm = 0
+            comp = max(hot, key=lambda c: hot[c][0])
+            if hot[comp][0] > self._open["score"]:
+                # attribution tracks the dominant drifting component
+                self._open.update(
+                    component=comp, score=round(hot[comp][0], 3),
+                    value=hot[comp][1], median=hot[comp][2])
+        else:
+            self._calm += 1
+            if self._calm >= self.cooldown:
+                self._open = None
+                self._calm = 0
+        return None
+
+    def verdicts(self):
+        """Host-side verdict snapshot for ``healthz``."""
+        with self._lock:
+            return {"group": self.group,
+                    "anomalous": self._open is not None,
+                    "episodes": self.episodes,
+                    "open": dict(self._open) if self._open else None,
+                    "scores": dict(self._last_scores)}
+
+
+_ANOMALY_LOCK = threading.Lock()
+_ANOMALY = {}
+
+
+def anomaly_watch(group="train"):
+    """Process-wide get-or-create :class:`AnomalyWatch` per feed
+    group (``train`` step splits, ``serving`` latency feeds)."""
+    with _ANOMALY_LOCK:
+        w = _ANOMALY.get(group)
+        if w is None:
+            w = _ANOMALY[group] = AnomalyWatch(group=group)
+        return w
+
+
+def anomaly_verdicts():
+    """Every group's verdicts (for ``healthz``); {} when nothing has
+    been fed yet."""
+    with _ANOMALY_LOCK:
+        watches = list(_ANOMALY.values())
+    return {w.group: w.verdicts() for w in watches}
+
+
+def reset_anomaly_for_tests():
+    """Drop all watch state (test isolation)."""
+    with _ANOMALY_LOCK:
+        _ANOMALY.clear()
 
 
 # ---------------------------------------------------------------------------

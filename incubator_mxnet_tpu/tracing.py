@@ -362,21 +362,57 @@ def install_signal_dump(signums=None):
     return True
 
 
+def _stitch_source(item):
+    """Normalize one stitch input to ``(src, iterable-of-records)``.
+
+    Accepts a dump-file path (str / os.PathLike), a live ``tracez``
+    reply dict (``{"events": [...], "rank": N, ...}``), or a bare
+    list of event dicts — so a fleet timeline can be assembled from
+    *running* processes (debugz ``tracez``) mixed with post-mortem
+    dump files, without killing anything.  Unreadable paths yield an
+    empty iterable (a killed rank never dumps; the rest still
+    stitch)."""
+    if isinstance(item, dict):
+        rank = item.get("rank")
+        src = (f"live:rank{rank}" if rank is not None
+               else "live:" + str(item.get("role", "?")))
+        return src, [e for e in item.get("events", ())
+                     if isinstance(e, dict)]
+    if isinstance(item, (list, tuple)):
+        return "live", [e for e in item if isinstance(e, dict)]
+    try:
+        with open(item, "r", encoding="utf-8") as fh:
+            raw = fh.read().splitlines()
+    except OSError:
+        return os.path.basename(str(item)), []
+    recs = []
+    for line in raw:
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict):
+            recs.append(rec)
+    return os.path.basename(str(item)), recs
+
+
 def stitch_dumps(paths, rid=None):
-    """Merge flight-recorder dump files into one fleet timeline.
+    """Merge flight-recorder sources into one fleet timeline.
 
     The router and each serving replica are separate processes, so
     one request's hops — ``router_dispatch`` on the router,
     ``fleet_dispatch``/``fleet_terminal`` on a replica,
     ``router_terminal`` back on the router — land in separate dump
     files (``MXTPU_TRACE_DUMP`` plus the per-rank suffix from
-    ``_dump_path``).  This loads every dump in ``paths``, tags each
-    event with its source file (``src`` = basename; the per-rank
-    suffix keeps these distinct), and returns one wall-clock-ordered
-    list, ties broken by source then per-source ``seq``.  Events
-    share a key: dispatch/terminal hops carry ``rid`` and
-    ``replica`` on both sides of the wire, so ``rid=`` narrows the
-    merge to a single request's cross-process story.
+    ``_dump_path``).  Each element of ``paths`` is a dump-file path
+    OR a live debugz ``tracez`` payload (reply dict or bare event
+    list — see :func:`_stitch_source`).  This loads every source,
+    tags each event with its origin (``src`` = file basename or
+    ``live:rankN``), and returns one wall-clock-ordered list, ties
+    broken by source then per-source ``seq``.  Events share a key:
+    dispatch/terminal hops carry ``rid`` and ``replica`` on both
+    sides of the wire, so ``rid=`` narrows the merge to a single
+    request's cross-process story.
 
     Paths that do not exist are skipped — a ``router:replica:kill``
     fault dies by ``os._exit`` and never dumps; the surviving files
@@ -384,19 +420,10 @@ def stitch_dumps(paths, rid=None):
     the same way (dumps are written atomically, but a glob may
     match a foreign or torn file)."""
     merged = []
-    for path in paths:
-        try:
-            with open(path, "r", encoding="utf-8") as fh:
-                raw = fh.read().splitlines()
-        except OSError:
-            continue
-        src = os.path.basename(str(path))
-        for line in raw:
-            try:
-                rec = json.loads(line)
-            except ValueError:
-                continue
-            if not isinstance(rec, dict) or "event" not in rec:
+    for item in paths:
+        src, recs = _stitch_source(item)
+        for rec in recs:
+            if "event" not in rec:
                 continue            # header / foreign line
             if rid is not None and rec.get("rid") != rid:
                 continue
@@ -545,17 +572,31 @@ MEMORY_KINDS = ("params", "optimizer", "kv_pools")
 # latest preflight memory plan (predicted peak live bytes), set by
 # perf.memory_planner at bind/preflight time; the heartbeat gauges
 # publish predicted-minus-measured drift against it
-_MEM_PLAN = {"bytes": None}
+_MEM_PLAN = {"bytes": None, "categories": None}
 
 
-def set_memory_plan(predicted_bytes):
+def set_memory_plan(predicted_bytes, categories=None):
     """Record the planner's latest predicted peak live bytes (None
     clears).  Host-side state only — read by
     :func:`update_memory_gauges` to publish
-    ``memory_plan_delta_bytes`` on the heartbeat cadence."""
+    ``memory_plan_delta_bytes`` on the heartbeat cadence.
+    ``categories`` optionally keeps the per-category byte breakdown
+    (params/optimizer/activations/...) so debugz ``memz`` can serve
+    the full plan, not just the total."""
     with _MEM_LOCK:
         _MEM_PLAN["bytes"] = None if predicted_bytes is None \
             else float(predicted_bytes)
+        _MEM_PLAN["categories"] = (
+            None if categories is None
+            else {str(k): float(v) for k, v in categories.items()})
+
+
+def memory_plan():
+    """Latest plan as ``{"predicted_bytes", "categories"}`` (both
+    None until a planner ran).  Served by debugz ``memz``."""
+    with _MEM_LOCK:
+        return {"predicted_bytes": _MEM_PLAN["bytes"],
+                "categories": _MEM_PLAN["categories"]}
 
 
 def register_memory(kind, provider, owner=None):
@@ -750,3 +791,4 @@ def reset_for_tests():
     with _MEM_LOCK:
         _MEM_PROVIDERS.clear()
         _MEM_PLAN["bytes"] = None
+        _MEM_PLAN["categories"] = None

@@ -281,6 +281,38 @@ register_env("MXTPU_STATUS_INTERVAL", float, 30.0,
              "status lines (built from per-worker heartbeat "
              "telemetry snapshots); 0 disables")
 
+# Introspection plane (debugz.py, tools/debugz.py;
+# docs/observability.md "Introspection plane").
+register_env("MXTPU_DEBUGZ", bool, True,
+             "embed the read-only debugz RPC endpoint in every "
+             "long-running process (train ranks, serving router/"
+             "replicas, remote data-service hosts); 0 disables the "
+             "endpoint entirely — no socket, no thread")
+register_env("MXTPU_DEBUGZ_PORT", int, 0,
+             "port the per-process debugz endpoint binds; 0 = "
+             "ephemeral (pair with MXTPU_DEBUGZ_PORTFILE for "
+             "race-free discovery)")
+register_env("MXTPU_DEBUGZ_PORTFILE", str, "",
+             "path the debugz endpoint writes its bound port to "
+             "(atomic temp+rename, the same handshake as the "
+             "replica/data-service --port-file); exported per rank "
+             "by tools/launch.py so live status polls can find the "
+             "endpoint; empty skips the port file")
+register_env("MXTPU_ANOMALY_WINDOW", int, 64,
+             "rolling window (samples) the AnomalyWatch keeps per "
+             "timeline component for its median/MAD baseline")
+register_env("MXTPU_ANOMALY_THRESHOLD", float, 6.0,
+             "MAD-normalized deviation score at which AnomalyWatch "
+             "opens an anomaly episode (value > median + "
+             "threshold * MAD)")
+register_env("MXTPU_ANOMALY_MIN_STEPS", int, 16,
+             "warmup samples per component before AnomalyWatch may "
+             "open an episode (an empty baseline flags everything)")
+register_env("MXTPU_ANOMALY_COOLDOWN", int, 8,
+             "consecutive below-threshold samples that close an "
+             "open anomaly episode (hysteresis: one sustained "
+             "regression is one episode, not a flapping stream)")
+
 # Flight recorder / tracing (tracing.py; docs/observability.md).
 register_env("MXTPU_TRACE_BUFFER", int, 4096,
              "flight-recorder ring-buffer capacity (structured "

@@ -138,6 +138,17 @@ _EXTRACTORS = {
          lambda d: _get(d, ("serving", "tokens_per_s")),
          "tok/s", True),
     ],
+    "debugz_introspection": [
+        ("debugz_tokens_per_s",
+         lambda d: _get(d, ("throughput", "tokens_per_s_debugz_on")),
+         "tok/s", True),
+        ("debugz_overhead_pct",
+         lambda d: _get(d, ("throughput", "overhead_pct")),
+         "%", False),
+        ("anomaly_detect_steps",
+         lambda d: _get(d, ("anomaly", "detect_steps")),
+         "steps", False),
+    ],
     "memory_pressure": [
         ("memory_plan_max_abs_delta",
          lambda d: _get(d, ("max_abs_rel_delta",)),

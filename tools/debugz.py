@@ -1,0 +1,250 @@
+#!/usr/bin/env python
+"""Fleet introspection client for the per-process debugz endpoints.
+
+Speaks the CRC-framed rpc.py wire protocol with nothing but the
+stdlib — like tools/launch.py, this tool deliberately never imports
+the package (it must run on a bare ops host, before jax is
+installed/importable), so the frame codec is re-stated here by value
+(magic ``MXRF``, header ``!4sIId`` = magic + payload-len + CRC32 +
+float64 budget, JSON payload).
+
+Usage:
+    # one process
+    python tools/debugz.py 127.0.0.1:9100 --op statusz
+
+    # fan out over a fleet (port files written by maybe_start /
+    # launch.py's MXTPU_DEBUGZ_PORTFILE export); a hung rank costs
+    # at most --deadline seconds and is reported, never waited on
+    python tools/debugz.py /tmp/hb/debugz-*.port --op healthz \
+        --deadline 2
+
+    # live status board, one line per rank, refreshed every 2 s
+    python tools/debugz.py /tmp/hb/debugz-*.port --watch
+
+Targets are ``host:port``, bare ports (host 127.0.0.1), or paths to
+port files containing ``host:port``.  Every query runs under its own
+monotonic per-target deadline; results stream back as one JSON
+object per target on stdout.
+"""
+import argparse
+import json
+import os
+import socket
+import struct
+import sys
+import threading
+import time
+import zlib
+
+MAGIC = b"MXRF"
+HEADER = struct.Struct("!4sIId")
+MAX_FRAME_BYTES = 64 << 20
+
+OPS = ("varz", "statusz", "tracez", "memz", "profilez", "healthz")
+
+
+# ---------------------------------------------------------------------------
+# minimal frame client (mirror of rpc.py, stdlib only)
+# ---------------------------------------------------------------------------
+
+
+def _recv_exact(sock, n, deadline):
+    buf = b""
+    while len(buf) < n:
+        rem = deadline - time.monotonic()
+        if rem <= 0:
+            raise TimeoutError("debugz deadline exceeded")
+        sock.settimeout(rem)
+        chunk = sock.recv(n - len(buf))  # deadline-ok: settimeout above
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        buf += chunk
+    return buf
+
+
+def frame_call(host, port, msg, timeout=5.0):
+    """Connect, send one frame, read one reply — all under a single
+    monotonic ``timeout`` deadline.  Returns the reply dict; raises
+    OSError/TimeoutError/ValueError on any failure (a SIGSTOPped
+    peer surfaces as TimeoutError, never a hang)."""
+    deadline = time.monotonic() + timeout
+    payload = json.dumps(msg, separators=(",", ":")).encode("utf-8")
+    header = HEADER.pack(MAGIC, len(payload),
+                         zlib.crc32(payload) & 0xFFFFFFFF, 0.0)
+    # deadline-ok: create_connection bounded by timeout arg
+    sock = socket.create_connection(
+        (host, int(port)), timeout=max(deadline - time.monotonic(),
+                                       0.001))
+    try:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.settimeout(max(deadline - time.monotonic(), 0.001))
+        sock.sendall(header + payload)
+        raw = _recv_exact(sock, HEADER.size, deadline)
+        magic, length, crc, _budget = HEADER.unpack(raw)
+        if magic != MAGIC:
+            raise ValueError(f"bad frame magic {magic!r}")
+        if length > MAX_FRAME_BYTES:
+            raise ValueError(f"absurd frame length {length}")
+        body = _recv_exact(sock, length, deadline)
+        if zlib.crc32(body) & 0xFFFFFFFF != crc:
+            raise ValueError("frame CRC mismatch")
+        return json.loads(body.decode("utf-8"))
+    finally:
+        sock.close()
+
+
+# ---------------------------------------------------------------------------
+# targets
+# ---------------------------------------------------------------------------
+
+
+def resolve_target(spec):
+    """``host:port`` / bare port / port-file path → (label, host,
+    port).  A port file that does not exist yet (rank still booting)
+    raises FileNotFoundError."""
+    if os.path.exists(spec):
+        with open(spec) as f:
+            addr = f.read().strip()
+        label = os.path.basename(spec)
+    else:
+        addr, label = spec, spec
+    if ":" in addr:
+        host, port = addr.rsplit(":", 1)
+    else:
+        host, port = "127.0.0.1", addr
+    return label, host, int(port)
+
+
+def query_fleet(targets, msg, deadline):
+    """Query every target concurrently, one bounded thread each.
+    Returns ``{label: reply-or-{"error": ...}}`` — always within
+    ~``deadline`` seconds regardless of hung ranks (worker threads
+    are daemonic; a wedged peer's thread is simply abandoned)."""
+    results = {}
+    lock = threading.Lock()
+
+    def one(spec):
+        try:
+            label, host, port = resolve_target(spec)
+        except (OSError, ValueError) as e:
+            with lock:
+                results[spec] = {"error": f"bad target: {e}"}
+            return
+        try:
+            reply = frame_call(host, port, msg, timeout=deadline)
+        except (OSError, ValueError) as e:
+            reply = {"error": f"{type(e).__name__}: {e}"}
+        with lock:
+            results[label] = reply
+
+    threads = [threading.Thread(target=one, args=(t,), daemon=True)
+               for t in targets]
+    for t in threads:
+        t.start()
+    join_by = time.monotonic() + deadline + 1.0
+    for t in threads:
+        t.join(max(join_by - time.monotonic(), 0.001))
+    with lock:
+        done = dict(results)
+    for spec in targets:
+        label = os.path.basename(spec) if os.path.exists(spec) \
+            else spec
+        if label not in done and spec not in done:
+            done[label] = {"error": "deadline exceeded (no reply)"}
+    return done
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def _status_line(label, reply):
+    if "error" in reply and "op" not in reply:
+        return f"{label:<28} !! {reply['error']}"
+    role = reply.get("role", "?")
+    up = reply.get("uptime_s", 0.0)
+    bits = [f"{label:<28} {role:<8} up={up:>8.1f}s"]
+    status = reply.get("status", {})
+    train = status.get("train")
+    if train:
+        bits.append(f"step={train.get('step')} "
+                    f"epoch={train.get('epoch')}")
+    eng = status.get("engine")
+    if eng:
+        bits.append(f"q={eng.get('queue_depth')} "
+                    f"run={eng.get('running')}")
+    router = status.get("router")
+    if router:
+        bits.append(f"live={router.get('live')} "
+                    f"pending={router.get('pending')}")
+    shards = status.get("shards")
+    if shards:
+        bits.append(f"streams={len(shards)}")
+    if "ok" in reply:
+        bits.append("OK" if reply["ok"] else "ANOMALOUS")
+    return "  ".join(bits)
+
+
+def build_msg(args):
+    msg = {"op": args.op}
+    if args.op == "tracez":
+        if args.event:
+            msg["event"] = args.event
+        if args.rid:
+            msg["rid"] = args.rid
+        if args.limit:
+            msg["limit"] = args.limit
+    if args.op == "profilez":
+        msg["seconds"] = args.seconds
+    return msg
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("targets", nargs="+",
+                    help="host:port, bare port, or port-file path")
+    ap.add_argument("--op", default="statusz", choices=OPS)
+    ap.add_argument("--deadline", type=float, default=5.0,
+                    help="per-target deadline seconds (default 5)")
+    ap.add_argument("--watch", action="store_true",
+                    help="live status board (statusz, refreshed)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="--watch refresh seconds (default 2)")
+    ap.add_argument("--event", default=None,
+                    help="tracez: filter by event name")
+    ap.add_argument("--rid", default=None,
+                    help="tracez: filter by request/run id")
+    ap.add_argument("--limit", type=int, default=0,
+                    help="tracez: tail length (0 = all)")
+    ap.add_argument("--seconds", type=float, default=1.0,
+                    help="profilez: capture window")
+    args = ap.parse_args(argv)
+
+    if args.watch:
+        args.op = "statusz"
+        try:
+            while True:
+                t0 = time.monotonic()
+                replies = query_fleet(args.targets, build_msg(args),
+                                      args.deadline)
+                stamp = time.strftime("%H:%M:%S")  # wallclock-ok: display
+                print(f"-- debugz fleet @ {stamp} "
+                      f"({len(replies)} targets) --")
+                for label in sorted(replies):
+                    print(_status_line(label, replies[label]))
+                sys.stdout.flush()
+                time.sleep(max(0.0, args.interval
+                               - (time.monotonic() - t0)))
+        except KeyboardInterrupt:
+            return 0
+
+    replies = query_fleet(args.targets, build_msg(args),
+                          args.deadline)
+    print(json.dumps(replies, indent=2, sort_keys=True))
+    return 1 if any("error" in r and "op" not in r
+                    for r in replies.values()) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

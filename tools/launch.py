@@ -41,6 +41,7 @@ Launch modes:
 no parameter servers in the collective design.
 """
 import argparse
+import importlib.util
 import json
 import os
 import shlex
@@ -49,6 +50,7 @@ import signal
 import socket
 import subprocess
 import sys
+import threading
 import time
 
 
@@ -191,6 +193,98 @@ def _hb_path(hb_dir, attempt, rank):
 
 
 # ---------------------------------------------------------------------------
+# live introspection (docs/observability.md "Introspection plane")
+#
+# Each worker embeds a debugz endpoint (debugz.maybe_start, port
+# published to MXTPU_DEBUGZ_PORTFILE = heartbeat path + ".debugz").
+# The monitor prefers asking a live process over reading file mtimes:
+# healthz answers prove liveness even when a slow filesystem delays
+# the beat, and varz returns a *current* snapshot instead of the last
+# interval's.  Every live call is deadline-bounded, and the heartbeat
+# file remains the fallback — a job with MXTPU_DEBUGZ=0 (or an old
+# worker) is monitored exactly as before.
+# ---------------------------------------------------------------------------
+
+_DZ_CLIENT = {"loaded": False, "mod": None}
+
+
+def _dz_portfile(hb_path):
+    """Debugz port file for one worker, derived from its heartbeat
+    path (same per-attempt freshness)."""
+    return hb_path + ".debugz"
+
+
+def _dz_client():
+    """Lazy-load the stdlib frame client from the adjacent
+    tools/debugz.py (the launcher never imports the package); None
+    when unavailable — all callers fall back to heartbeat files."""
+    if not _DZ_CLIENT["loaded"]:
+        _DZ_CLIENT["loaded"] = True
+        try:
+            path = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "debugz.py")
+            spec = importlib.util.spec_from_file_location(
+                "_launch_debugz_client", path)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            _DZ_CLIENT["mod"] = mod
+        except Exception:
+            _DZ_CLIENT["mod"] = None
+    return _DZ_CLIENT["mod"]
+
+
+def _dz_call(hb_path, msg, deadline):
+    """One bounded debugz call to the worker owning ``hb_path``;
+    None on any failure (no endpoint, hung peer, torn port file)."""
+    dz = _dz_client()
+    if dz is None or hb_path is None:
+        return None
+    try:
+        with open(_dz_portfile(hb_path)) as f:
+            host, port = f.read().strip().rsplit(":", 1)
+        return dz.frame_call(host, int(port), msg, timeout=deadline)
+    except Exception:
+        return None
+
+
+def _live_fresh(hb_path, deadline=1.0):
+    """True when the worker's debugz healthz answers — direct proof
+    of liveness, used before trusting a stale file mtime (a loaded
+    NFS heartbeat dir must not get a healthy rank killed)."""
+    reply = _dz_call(hb_path, {"op": "healthz"}, deadline)
+    return reply is not None and "error" not in reply
+
+
+def _live_snapshots(hb_files, deadline=1.0):
+    """rank -> current telemetry snapshot via live debugz ``varz``,
+    queried concurrently with one bounded thread per rank (a
+    SIGSTOPped rank costs ~``deadline`` seconds total, not per
+    rank).  Ranks without a live reply are simply absent."""
+    if not hb_files or _dz_client() is None:
+        return {}
+    out = {}
+    lock = threading.Lock()
+
+    def one(rank, path):
+        reply = _dz_call(path, {"op": "varz"}, deadline)
+        snap = reply.get("telemetry") if reply else None
+        if isinstance(snap, dict):
+            with lock:
+                out[rank] = snap
+
+    threads = [threading.Thread(target=one, args=(r, p), daemon=True)
+               for r, p in hb_files.items()]
+    for t in threads:
+        t.start()
+    join_by = time.time() + deadline + 0.5
+    for t in threads:
+        t.join(max(join_by - time.time(), 0.001))
+    with lock:
+        return dict(out)
+
+
+# ---------------------------------------------------------------------------
 # telemetry aggregation (docs/observability.md)
 #
 # Workers append their current metric snapshot as a second JSON line
@@ -218,7 +312,10 @@ _ERROR_COUNTERS = ("retry_attempts_total", "collective_aborts_total",
                    "serving_cancelled_total", "serving_drains_total",
                    # memory-pressure survival (docs/memory.md):
                    # preflight ladder rungs taken, runtime OOM retries
-                   "memory_plan_degrades_total", "oom_retries_total")
+                   "memory_plan_degrades_total", "oom_retries_total",
+                   # anomaly watchdog episodes (docs/observability.md
+                   # "Introspection plane")
+                   "anomaly_detections_total")
 
 
 def _read_heartbeat(path):
@@ -250,9 +347,13 @@ def _read_heartbeat(path):
 
 
 def _collect_snapshots(hb_files):
-    """rank -> snapshot for every heartbeat file carrying one."""
-    snaps = {}
+    """rank -> snapshot, live debugz ``varz`` preferred (current
+    counters — straggler step counts from *now*, not the last beat),
+    heartbeat-file ride-along as the per-rank fallback."""
+    snaps = _live_snapshots(hb_files)
     for rank, path in (hb_files or {}).items():
+        if rank in snaps:
+            continue
         _, snap = _read_heartbeat(path)
         if snap is not None:
             snaps[rank] = snap
@@ -509,10 +610,16 @@ def _run_once(spawners, hb_files=None, hb_timeout=0,
                             age = now - os.path.getmtime(hb_files[r])
                         except OSError:
                             continue    # no heartbeat yet: unmonitored
-                        if age > hb_timeout:
+                        if age > hb_timeout \
+                                and not _live_fresh(hb_files[r]):
+                            # stale file AND no live healthz answer:
+                            # truly wedged (a SIGSTOPped worker fails
+                            # both; a slow-filesystem one passes the
+                            # bounded live probe and survives)
                             print(f"launch.py: worker {r} hung (no "
                                   f"heartbeat for {age:.0f}s > "
-                                  f"{hb_timeout:.0f}s); killing it",
+                                  f"{hb_timeout:.0f}s, debugz "
+                                  "unresponsive); killing it",
                                   file=sys.stderr)
                             p.kill()
                             killed.add(r)
@@ -629,6 +736,7 @@ def _run_fleet(args, cmd, hb_dir):
             env["MXTPU_HEARTBEAT_FILE"] = hb
             env["MXTPU_HEARTBEAT_INTERVAL"] = \
                 str(args.heartbeat_interval)
+            env["MXTPU_DEBUGZ_PORTFILE"] = _dz_portfile(hb)
         members[key] = {"proc": subprocess.Popen(cmd, env=env),
                         "hb": hb, "role": role, "rank": rank,
                         "killed": False}
@@ -645,7 +753,8 @@ def _run_fleet(args, cmd, hb_dir):
             age = now - os.path.getmtime(m["hb"])
         except OSError:
             return True     # no heartbeat yet: unmonitored
-        return age <= args.heartbeat_timeout
+        return age <= args.heartbeat_timeout \
+            or _live_fresh(m["hb"])
 
     restarts = 0
     rate_state = {"ts": None, "total": 0}
@@ -671,11 +780,13 @@ def _run_fleet(args, cmd, hb_dir):
                         age = now - os.path.getmtime(m["hb"])
                     except OSError:
                         continue    # no heartbeat yet: unmonitored
-                    if age > args.heartbeat_timeout:
+                    if age > args.heartbeat_timeout \
+                            and not _live_fresh(m["hb"]):
                         print(f"launch.py: fleet member {key} hung "
                               f"(no heartbeat for {age:.0f}s > "
-                              f"{args.heartbeat_timeout:.0f}s); "
-                              "killing it", file=sys.stderr)
+                              f"{args.heartbeat_timeout:.0f}s, "
+                              "debugz unresponsive); killing it",
+                              file=sys.stderr)
                         p.kill()
                         m["killed"] = True
             # the router's exit decides the job
@@ -831,6 +942,7 @@ class _DataFleet:
             extra["MXTPU_HEARTBEAT_FILE"] = m["hb"]
             extra["MXTPU_HEARTBEAT_INTERVAL"] = \
                 str(self.args.heartbeat_interval)
+            extra["MXTPU_DEBUGZ_PORTFILE"] = _dz_portfile(m["hb"])
         if self._is_local(m):
             env = dict(os.environ)
             env.update(extra)
@@ -1149,10 +1261,11 @@ def main():
                 env.update(_worker_env(args, r, coord, attempt,
                                        world))
                 if hb_dir is not None:
-                    env["MXTPU_HEARTBEAT_FILE"] = \
-                        _hb_path(hb_dir, attempt, r)
+                    hb = _hb_path(hb_dir, attempt, r)
+                    env["MXTPU_HEARTBEAT_FILE"] = hb
                     env["MXTPU_HEARTBEAT_INTERVAL"] = \
                         str(args.heartbeat_interval)
+                    env["MXTPU_DEBUGZ_PORTFILE"] = _dz_portfile(hb)
 
                 def spawn(env=env):
                     return subprocess.Popen(cmd, env=env)
